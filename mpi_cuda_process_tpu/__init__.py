@@ -1,0 +1,37 @@
+"""TPU-native distributed stencil / finite-difference framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capability set of the reference
+MPI+CUDA mini-app (Rodrigovicente/MPI-CUDA-Process): double-buffered stencil
+time stepping (Game of Life, heat/Laplace, 27-point, FDTD wave), guard-cell
+boundary conditions, deterministic random init, N-D spatial domain
+decomposition over a device mesh with per-step ``ppermute`` halo exchange, and
+communication/computation overlap — see SURVEY.md for the full blueprint.
+"""
+
+from .config import RunConfig
+from .driver import make_runner, make_step, run_simulation
+from .ops import heat, life, wave  # noqa: F401  (register stencils)
+from .ops.stencil import Stencil, available_stencils, make_stencil
+from .parallel.halo import exchange_and_pad
+from .parallel.mesh import make_mesh, spatial_axis_names
+from .parallel.stepper import make_sharded_step, shard_fields
+from .utils.init import init_state
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "RunConfig",
+    "Stencil",
+    "available_stencils",
+    "exchange_and_pad",
+    "init_state",
+    "make_mesh",
+    "make_runner",
+    "make_sharded_step",
+    "make_stencil",
+    "make_step",
+    "run_simulation",
+    "shard_fields",
+    "spatial_axis_names",
+    "__version__",
+]

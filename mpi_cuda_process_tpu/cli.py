@@ -1,0 +1,175 @@
+"""Command-line entry point.
+
+Replaces the reference's L5 layer (``main`` + interactive scanf,
+kernel.cu:148-284) with an argparse CLI: every BASELINE.json config is one
+command line, e.g.::
+
+    python -m mpi_cuda_process_tpu --stencil heat2d --grid 512,512 --iters 1000
+    python -m mpi_cuda_process_tpu --stencil heat3d --grid 1024,1024,1024 \
+        --iters 100 --mesh 2,2
+    python -m mpi_cuda_process_tpu --stencil life --grid 256,256 --iters 100 \
+        --render
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+import math
+import sys
+import time
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import driver
+from .config import RunConfig, parse_int_tuple, parse_params
+from .ops import stencil as stencil_lib
+from .ops import heat, life, wave  # noqa: F401  (populate the registry)
+from .parallel import mesh as mesh_lib
+from .parallel import stepper as stepper_lib
+from .utils import checkpointing, render
+from .utils.init import init_state
+
+log = logging.getLogger("mpi_cuda_process_tpu")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi_cuda_process_tpu",
+        description="TPU-native distributed stencil / finite-difference framework",
+    )
+    p.add_argument("--stencil", default="heat2d",
+                   choices=stencil_lib.available_stencils())
+    p.add_argument("--grid", type=parse_int_tuple, default=(512, 512),
+                   help="grid shape, e.g. 512,512 or 256x256x256")
+    p.add_argument("--iters", type=int, default=1000)
+    p.add_argument("--dtype", default=None,
+                   help="float32|bfloat16|int32|... (default: stencil's own)")
+    p.add_argument("--mesh", type=parse_int_tuple, default=(),
+                   help="per-grid-axis shard counts, e.g. 2,2 (default: no sharding)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--density", type=float, default=0.15,
+                   help="alive probability for random init (reference: 0.15)")
+    p.add_argument("--init", default="auto",
+                   choices=["auto", "random", "zero", "pulse"])
+    p.add_argument("--periodic", action="store_true",
+                   help="periodic BCs instead of guard-cell frame")
+    p.add_argument("--param", action="append", default=[],
+                   help="stencil parameter override, key=value (repeatable)")
+    p.add_argument("--log-every", type=int, default=0)
+    p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--render", action="store_true",
+                   help="ASCII-render the final grid")
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace for the run")
+    return p
+
+
+def config_from_args(argv=None) -> RunConfig:
+    a = build_parser().parse_args(argv)
+    return RunConfig(
+        stencil=a.stencil, grid=a.grid, iters=a.iters, dtype=a.dtype,
+        mesh=a.mesh, seed=a.seed, density=a.density, init=a.init,
+        periodic=a.periodic, log_every=a.log_every,
+        checkpoint_every=a.checkpoint_every, checkpoint_dir=a.checkpoint_dir,
+        resume=a.resume, render=a.render, profile_dir=a.profile_dir,
+        params=parse_params(a.param),
+    )
+
+
+def build(cfg: RunConfig):
+    """Materialize (stencil, step_fn, fields, start_step) from a config."""
+    params = dict(cfg.params)
+    if cfg.dtype:
+        params.setdefault("dtype", jnp.dtype(cfg.dtype))
+    st = stencil_lib.make_stencil(cfg.stencil, **params)
+
+    start_step = 0
+    if cfg.resume and cfg.checkpoint_dir and \
+            checkpointing.latest_step(cfg.checkpoint_dir) is not None:
+        np_fields, start_step, _ = checkpointing.load_checkpoint(cfg.checkpoint_dir)
+        fields = tuple(jnp.asarray(f) for f in np_fields)
+        log.info("resumed from %s at step %d", cfg.checkpoint_dir, start_step)
+    else:
+        fields = init_state(st, cfg.grid, cfg.seed, cfg.density, cfg.init,
+                            periodic=cfg.periodic)
+
+    if cfg.mesh and math.prod(cfg.mesh) > 1:
+        m = mesh_lib.make_mesh(cfg.mesh)
+        step_fn = stepper_lib.make_sharded_step(
+            st, m, cfg.grid, periodic=cfg.periodic)
+        fields = stepper_lib.shard_fields(fields, m, st.ndim)
+    else:
+        step_fn = driver.make_step(st, cfg.grid, periodic=cfg.periodic)
+    return st, step_fn, fields, start_step
+
+
+def run(cfg: RunConfig) -> Tuple:
+    """Execute a configured run; returns (final_fields, mcells_per_s)."""
+    mesh_lib.bootstrap_distributed()
+    st, step_fn, fields, start_step = build(cfg)
+    remaining = cfg.iters - start_step
+    if remaining <= 0:
+        log.info("checkpoint already at step %d >= iters", start_step)
+        return fields, 0.0
+
+    cells = math.prod(cfg.grid)
+
+    def callback(done_in_run, fs):
+        step = start_step + done_in_run
+        if cfg.log_every and step % cfg.log_every == 0:
+            diag = float(jnp.sum(fs[0]))
+            log.info("step %d  sum(field0)=%.6g", step, diag)
+        if cfg.checkpoint_every and cfg.checkpoint_dir and \
+                step % cfg.checkpoint_every == 0:
+            checkpointing.save_checkpoint(
+                cfg.checkpoint_dir, fs, step, dataclasses.asdict(cfg))
+
+    interval = 0
+    if cfg.log_every or cfg.checkpoint_every:
+        opts = [v for v in (cfg.log_every, cfg.checkpoint_every) if v]
+        interval = math.gcd(*opts) if len(opts) > 1 else opts[0]
+
+    ctx = None
+    if cfg.profile_dir:
+        ctx = jax.profiler.trace(cfg.profile_dir)
+        ctx.__enter__()
+    t0 = time.perf_counter()
+    try:
+        fields = driver.run_simulation(
+            st, fields, remaining, step_fn=step_fn,
+            log_every=interval, callback=callback, start_step=start_step)
+        fields = jax.block_until_ready(fields)
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+    dt = time.perf_counter() - t0
+    mcells = cells * remaining / dt / 1e6
+
+    if cfg.checkpoint_dir and cfg.checkpoint_every:
+        checkpointing.save_checkpoint(
+            cfg.checkpoint_dir, fields, cfg.iters, dataclasses.asdict(cfg))
+    log.info("%d steps on %s grid in %.3fs  (%.1f Mcells/s)",
+             remaining, "x".join(map(str, cfg.grid)), dt, mcells)
+    if cfg.render:
+        print(render.ascii_render(np.asarray(fields[0])))
+    return fields, mcells
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    cfg = config_from_args(argv)
+    run(cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

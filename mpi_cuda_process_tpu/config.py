@@ -1,0 +1,71 @@
+"""Run configuration.
+
+Replaces the reference's interactive ``scanf`` of three ints (g, h, w —
+kernel.cu:152-159, run *before* MPI_Init on every rank, which only works if
+stdin is forwarded to all ranks) and its scattering of hard-coded constants
+(``NUM_THREADS 512`` kernel.cu:6, density 0.15 kernel.cu:193, Dirichlet 100.0
+MDF_kernel.cu:93, split factor 2 everywhere) with one frozen dataclass,
+serialized into checkpoints and benchmark records (SURVEY.md §5.6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    stencil: str = "heat2d"
+    grid: Tuple[int, ...] = (512, 512)
+    iters: int = 1000
+    dtype: Optional[str] = None  # None = the stencil's own default dtype
+    mesh: Tuple[int, ...] = ()  # per-grid-axis shard counts; () = unsharded
+    seed: int = 0
+    density: float = 0.15
+    init: str = "auto"
+    periodic: bool = False
+    log_every: int = 0
+    checkpoint_every: int = 0
+    checkpoint_dir: Optional[str] = None
+    resume: bool = False
+    render: bool = False
+    profile_dir: Optional[str] = None
+    params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RunConfig":
+        d = dict(d)
+        for k in ("grid", "mesh"):
+            if k in d and d[k] is not None:
+                d[k] = tuple(d[k])
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+def parse_int_tuple(s: str) -> Tuple[int, ...]:
+    s = s.strip()
+    if not s:
+        return ()
+    return tuple(int(p) for p in s.replace("x", ",").split(",") if p.strip())
+
+
+def parse_params(pairs) -> Dict[str, Any]:
+    """Parse repeated ``--param key=value`` flags (values as float/int/str)."""
+    out: Dict[str, Any] = {}
+    for p in pairs or ():
+        k, _, v = p.partition("=")
+        if not _:
+            raise ValueError(f"--param expects key=value, got {p!r}")
+        try:
+            out[k] = int(v)
+        except ValueError:
+            try:
+                out[k] = float(v)
+            except ValueError:
+                out[k] = v
+    return out

@@ -1,0 +1,102 @@
+"""Heat / Laplacian diffusion stencils (FTCS, Jacobi-style double buffer).
+
+Capability parity with the reference's ``run_mdf`` device function
+(MDF_kernel.cu:10-22): the forward-Euler heat update
+``new = u + alpha * (u_E + u_W + u_N + u_S - 4 u)`` at MDF_kernel.cu:20 with
+``alpha = 0.25`` (the 2D stability limit) and a hot Dirichlet guard frame of
+100.0 (MDF_kernel.cu:92-93).  Extended beyond the reference per BASELINE.json:
+3D 7-point, and a 3D 27-point isotropic high-order Laplacian (halo 1, full
+3x3x3 footprint — the corner-coupling case that exercises two-pass halo
+exchange).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax.numpy as jnp
+
+from .stencil import Stencil, axis_laplacian, interior, register, shifted
+
+
+def _make_laplacian_update(ndim, alpha):
+    def update(padded):
+        (p,) = padded
+        u, lap = axis_laplacian(p, ndim)
+        return (u + alpha * lap,)
+
+    return update
+
+
+@register("heat2d")
+def heat2d(alpha=0.25, bc=100.0, dtype=jnp.float32) -> Stencil:
+    """2D 5-point FTCS heat diffusion (the reference's MDF model)."""
+    return Stencil(
+        name="heat2d",
+        ndim=2,
+        halo=1,
+        num_fields=1,
+        dtype=jnp.dtype(dtype),
+        bc_value=(bc,),
+        update=_make_laplacian_update(2, alpha),
+        params={"alpha": alpha, "bc": bc},
+    )
+
+
+@register("heat3d")
+def heat3d(alpha=1.0 / 6.0, bc=100.0, dtype=jnp.float32) -> Stencil:
+    """3D 7-point FTCS heat diffusion (BASELINE.json configs 2-3)."""
+    return Stencil(
+        name="heat3d",
+        ndim=3,
+        halo=1,
+        num_fields=1,
+        dtype=jnp.dtype(dtype),
+        bc_value=(bc,),
+        update=_make_laplacian_update(3, alpha),
+        params={"alpha": alpha, "bc": bc},
+    )
+
+
+# Isotropic 27-point Laplacian weights (x 1/30): faces 14, edges 3, corners 1,
+# center -128.  Second moments per axis sum to 2 => consistent with the 7-point
+# Laplacian but with O(h^2) error isotropic in direction.
+_W_FACE = 14.0 / 30.0
+_W_EDGE = 3.0 / 30.0
+_W_CORNER = 1.0 / 30.0
+_W_CENTER = -128.0 / 30.0
+
+
+def _heat3d27_update_factory(alpha):
+    def update(padded):
+        (p,) = padded
+        u = interior(p, 1, 3)
+        acc = _W_CENTER * u
+        for off in itertools.product((-1, 0, 1), repeat=3):
+            nz = sum(1 for o in off if o != 0)
+            if nz == 0:
+                continue
+            w = (_W_FACE, _W_EDGE, _W_CORNER)[nz - 1]
+            acc = acc + w * shifted(p, off, 1)
+        return (u + alpha * acc,)
+
+    return update
+
+
+@register("heat3d27")
+def heat3d27(alpha=0.15, bc=100.0, dtype=jnp.float32) -> Stencil:
+    """3D 27-point isotropic Laplacian diffusion (BASELINE.json config 4).
+
+    Full 3x3x3 footprint: needs corner/edge halo data, which the two-pass
+    axis-wise exchange in parallel/halo.py provides.
+    """
+    return Stencil(
+        name="heat3d27",
+        ndim=3,
+        halo=1,
+        num_fields=1,
+        dtype=jnp.dtype(dtype),
+        bc_value=(bc,),
+        update=_heat3d27_update_factory(alpha),
+        params={"alpha": alpha, "bc": bc},
+    )

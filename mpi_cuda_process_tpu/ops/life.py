@@ -1,0 +1,45 @@
+"""Conway's Game of Life as a stencil op.
+
+Capability parity with the reference's ``game_of_life`` device function
+(kernel.cu:10-68): 8-neighbor count + B3/S23 rule
+``n_alive == 3 || (n_alive == 2 && alive)`` (kernel.cu:66), dead guard frame
+(kernel.cu:137-138).  The reference's 50-line edge-case cascade
+(kernel.cu:23-64, with its dead unsigned-comparison guards) collapses into a
+sum of eight shifted slices over the halo-padded block.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax.numpy as jnp
+
+from .stencil import Stencil, interior, register, shifted
+
+
+def _life_update(padded):
+    (p,) = padded
+    n = None
+    for off in itertools.product((-1, 0, 1), repeat=2):
+        if off == (0, 0):
+            continue
+        s = shifted(p, off, 1)
+        n = s if n is None else n + s
+    alive = interior(p, 1, 2)
+    born_or_survives = (n == 3) | ((n == 2) & (alive == 1))
+    return (born_or_survives.astype(p.dtype),)
+
+
+@register("life")
+def life(dtype=jnp.int32) -> Stencil:
+    """B3/S23 Game of Life, 2D, halo 1, dead (0) boundary."""
+    return Stencil(
+        name="life",
+        ndim=2,
+        halo=1,
+        num_fields=1,
+        dtype=jnp.dtype(dtype),
+        bc_value=(0,),
+        update=_life_update,
+        params={},
+    )

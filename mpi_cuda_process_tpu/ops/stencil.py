@@ -1,0 +1,142 @@
+"""Stencil op protocol — the single shared abstraction of the framework.
+
+The reference repo (Rodrigovicente/MPI-CUDA-Process) "adds a new physics model"
+by copy-pasting a ~240-line CUDA+MPI file and editing ~30 lines: ``kernel.cu``
+and ``MDF_kernel.cu`` are ~85% identical, differing only in dtype, the per-cell
+op (``game_of_life`` kernel.cu:10-68 vs ``run_mdf`` MDF_kernel.cu:10-22), the
+guard-cell value (0 vs 100.0) and init (SURVEY.md §2.3).  This module factors
+that skeleton once: a :class:`Stencil` bundles exactly the things that varied
+between the two reference programs — dtype, footprint/halo width, per-field
+guard-cell (boundary) values, and the update rule — and everything else
+(time stepping, domain decomposition, halo exchange, I/O) is shared machinery
+that consumes a ``Stencil``.
+
+Update functions are written array-level over *halo-padded* blocks (shifted
+slices), so the reference's per-thread index arithmetic and its out-of-bounds
+hazards (unsigned-wrap edge guards, kernel.cu:23-64) are structurally
+impossible here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+Fields = Tuple[Array, ...]
+# An update fn maps halo-padded fields -> new interior-shaped fields.
+UpdateFn = Callable[[Fields], Fields]
+
+
+@dataclasses.dataclass(frozen=True)
+class Stencil:
+    """A stencil model: everything that differed between the reference's two programs.
+
+    Attributes:
+      name: registry key (e.g. ``"life"``, ``"heat2d"``).
+      ndim: spatial rank of the grid (2 or 3).
+      halo: footprint radius = halo width = guard-frame width.  The reference
+        hard-codes 1 (one shared-border row, kernel.cu:97-105); here it is a
+        first-class parameter so high-order stencils work unchanged.
+      num_fields: fields in the state (1 for Life/heat, 2 for FDTD wave).
+      dtype: element dtype of every field.
+      bc_value: per-field guard-cell constant — the generalization of the
+        reference's dead frame (0, kernel.cu:137-138) and hot Dirichlet wall
+        (100.0, MDF_kernel.cu:92-93).
+      update: pure function, halo-padded fields -> new interior fields.
+      params: free parameters of the model (e.g. diffusion number ``alpha``),
+        recorded for config serialization.
+    """
+
+    name: str
+    ndim: int
+    halo: int
+    num_fields: int
+    dtype: Any
+    bc_value: Tuple[float, ...]
+    update: UpdateFn
+    params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Per-field halo widths; None means every field needs the full ``halo``.
+    # Fields whose neighbors are never read (e.g. the wave model's u_prev,
+    # which only appears as its own cell) declare 0 and skip halo exchange —
+    # halving the wave model's ICI traffic.
+    field_halos: Tuple[int, ...] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.field_halos is None:
+            object.__setattr__(
+                self, "field_halos", (self.halo,) * self.num_fields
+            )
+        if len(self.field_halos) != self.num_fields:
+            raise ValueError("field_halos length != num_fields")
+
+    def pad_width(self) -> int:
+        return self.halo
+
+
+def axis_offsets(ndim: int):
+    """Unit offsets along each axis: the 2*ndim face neighbors."""
+    for d in range(ndim):
+        for s in (-1, 1):
+            off = [0] * ndim
+            off[d] = s
+            yield tuple(off)
+
+
+def axis_laplacian(padded: Array, ndim: int, halo: int = 1):
+    """Return ``(u, lap)``: interior view and the 2*ndim-point Laplacian."""
+    u = interior(padded, halo, ndim)
+    acc = None
+    for off in axis_offsets(ndim):
+        s = shifted(padded, off, halo)
+        acc = s if acc is None else acc + s
+    return u, acc - 2 * ndim * u
+
+
+def shifted(padded: Array, offsets: Tuple[int, ...], halo: int) -> Array:
+    """Interior-shaped view of ``padded`` shifted by ``offsets``.
+
+    ``offsets[d]`` in ``[-halo, halo]``.  Replaces the reference's flat-index
+    neighbor arithmetic (``id ± 1``, ``id ± w`` — kernel.cu:13-18) with static
+    slices that cannot go out of bounds.
+    """
+    idx = []
+    for o in offsets:
+        start = halo + o
+        stop = o - halo
+        idx.append(slice(start, stop if stop != 0 else None))
+    return padded[tuple(idx)]
+
+
+def interior(padded: Array, halo: int, ndim: int) -> Array:
+    return shifted(padded, (0,) * ndim, halo)
+
+
+# ----------------------------------------------------------------------------
+# Registry: name -> factory(**params) -> Stencil
+# ----------------------------------------------------------------------------
+
+_REGISTRY: Dict[str, Callable[..., Stencil]] = {}
+
+
+def register(name: str):
+    def deco(factory: Callable[..., Stencil]):
+        _REGISTRY[name] = factory
+        return factory
+
+    return deco
+
+
+def make_stencil(name: str, **params) -> Stencil:
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown stencil {name!r}; available: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[name](**params)
+
+
+def available_stencils():
+    return sorted(_REGISTRY)

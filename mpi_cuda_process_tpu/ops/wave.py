@@ -1,0 +1,58 @@
+"""FDTD wave equation (2nd order in time) as a two-field stencil.
+
+Not present in the reference (which has only single-field Jacobi updates);
+required by BASELINE.json config 5 ("3D wave-equation FDTD (2nd-order in
+time), 4096^3 grid").  Exercises the multi-field state path: the carry is
+``(u, u_prev)`` and the leapfrog update is
+
+    u_new = 2 u - u_prev + c2dt2 * Lap(u)
+
+with homogeneous Dirichlet (reflecting) guard cells, the same guard-frame
+mechanism as the reference's MDF walls (MDF_kernel.cu:92-93).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .stencil import Stencil, axis_laplacian, register
+
+
+def _make_wave_update(ndim, c2dt2):
+    def update(padded):
+        pu, uprev = padded  # u_prev has field_halo 0: arrives unpadded
+        u, lap = axis_laplacian(pu, ndim)
+        return (2.0 * u - uprev + c2dt2 * lap, u)
+
+    return update
+
+
+@register("wave2d")
+def wave2d(c2dt2=0.25, dtype=jnp.float32) -> Stencil:
+    return Stencil(
+        name="wave2d",
+        ndim=2,
+        halo=1,
+        num_fields=2,
+        dtype=jnp.dtype(dtype),
+        bc_value=(0.0, 0.0),
+        update=_make_wave_update(2, c2dt2),
+        params={"c2dt2": c2dt2},
+        field_halos=(1, 0),
+    )
+
+
+@register("wave3d")
+def wave3d(c2dt2=1.0 / 6.0, dtype=jnp.float32) -> Stencil:
+    """3D FDTD wave (BASELINE.json config 5). Stable for c2dt2 <= 1/3."""
+    return Stencil(
+        name="wave3d",
+        ndim=3,
+        halo=1,
+        num_fields=2,
+        dtype=jnp.dtype(dtype),
+        bc_value=(0.0, 0.0),
+        update=_make_wave_update(3, c2dt2),
+        params={"c2dt2": c2dt2},
+        field_halos=(1, 0),
+    )

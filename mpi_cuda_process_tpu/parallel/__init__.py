@@ -1,0 +1,14 @@
+from .halo import exchange_and_pad, exchange_pad_axis
+from .mesh import bootstrap_distributed, make_mesh, spatial_axis_names
+from .stepper import grid_partition_spec, make_sharded_step, shard_fields
+
+__all__ = [
+    "bootstrap_distributed",
+    "exchange_and_pad",
+    "exchange_pad_axis",
+    "grid_partition_spec",
+    "make_mesh",
+    "make_sharded_step",
+    "shard_fields",
+    "spatial_axis_names",
+]

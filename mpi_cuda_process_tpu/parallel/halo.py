@@ -1,0 +1,110 @@
+"""Width-k halo exchange over the device mesh via ``jax.lax.ppermute``.
+
+TPU-native replacement for the reference's MPI halo exchange (C16,
+kernel.cu:213-217/227-230/246-263): one ``ppermute`` per direction per sharded
+axis moves the whole halo slab as a single fused ICI transfer, fixing by
+construction the reference's three backend-level inefficiencies (SURVEY.md
+§5.8): host-staged traffic, one-MPI-message-per-element
+(``for i: MPI_Send(&row[i], 1, ...)`` kernel.cu:228-230), and fully blocking
+exchange (XLA schedules collective-permute async against independent compute).
+
+It also implements the *intended* exchange protocol of SURVEY.md §3.3, not the
+as-written one (rank 1 sending to itself, kernel.cu:262).  There is no
+per-rank branching: every shard runs the same code; edge shards substitute the
+stencil's guard-cell constant for the missing neighbor slab.
+
+Corner/edge halos (needed by 27-point footprints) come from the two-pass
+axis-wise scheme (SURVEY.md §7.3.2): exchanging axis d AFTER axes < d have
+been padded transports corner data with face-only transfers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _take(x: jax.Array, axis: int, start: int, size: int) -> jax.Array:
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(start, start + size)
+    return x[tuple(idx)]
+
+
+def exchange_pad_axis(
+    x: jax.Array,
+    axis: int,
+    axis_name: Optional[str],
+    n_shards: int,
+    halo: int,
+    bc_value,
+    periodic: bool = False,
+) -> jax.Array:
+    """Pad ``x`` with ``halo`` cells on both ends of ``axis``.
+
+    Interior faces receive the neighbor shard's border slab (ppermute);
+    global faces receive ``bc_value`` (or wrap around when ``periodic``).
+    With ``n_shards == 1`` (or no mesh axis) this degrades to a local pad/roll,
+    so the same step code serves sharded and unsharded axes.
+    """
+    hi_slab = _take(x, axis, x.shape[axis] - halo, halo)  # my last rows
+    lo_slab = _take(x, axis, 0, halo)  # my first rows
+
+    if axis_name is None or n_shards == 1:
+        if periodic:
+            left, right = hi_slab, lo_slab
+        else:
+            bc = jnp.asarray(bc_value, x.dtype)
+            shape = list(x.shape)
+            shape[axis] = halo
+            left = jnp.full(shape, bc, x.dtype)
+            right = left
+        return jnp.concatenate([left, x, right], axis=axis)
+
+    # Downward shift: shard i's hi_slab -> shard i+1's left halo.
+    down = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    # Upward shift: shard i's lo_slab -> shard i-1's right halo.
+    up = [(i, (i - 1) % n_shards) for i in range(n_shards)]
+    if not periodic:
+        down = down[:-1]
+        up = up[1:]
+    from_left = lax.ppermute(hi_slab, axis_name, down)
+    from_right = lax.ppermute(lo_slab, axis_name, up)
+
+    if not periodic:
+        # Edge shards got zeros from the truncated permutation; substitute the
+        # guard-cell constant (the reference's pinned frame value).
+        idx = lax.axis_index(axis_name)
+        bc = jnp.asarray(bc_value, x.dtype)
+        from_left = jnp.where(idx == 0, bc, from_left)
+        from_right = jnp.where(idx == n_shards - 1, bc, from_right)
+
+    return jnp.concatenate([from_left, x, from_right], axis=axis)
+
+
+def exchange_and_pad(
+    x: jax.Array,
+    axis_names: Sequence[Optional[str]],
+    shard_counts: Sequence[int],
+    halo: int,
+    bc_value,
+    periodic: bool = False,
+) -> jax.Array:
+    """Halo-pad every spatial axis of a local block (two-pass axis-wise).
+
+    ``axis_names[d]``/``shard_counts[d]`` describe how grid axis d is sharded
+    (name None or count 1 => unsharded).  Axis d is exchanged after axes < d
+    are already padded, so diagonal (corner/edge) neighbor data arrives via
+    face exchanges only — the plan chosen in SURVEY.md §7.3 for 27-point
+    footprints.
+
+    ``halo == 0`` (a field whose neighbors are never read, e.g. wave u_prev)
+    is a no-op: no transfer, no pad.
+    """
+    if halo == 0:
+        return x
+    for d, (name, cnt) in enumerate(zip(axis_names, shard_counts)):
+        x = exchange_pad_axis(x, d, name, cnt, halo, bc_value, periodic)
+    return x

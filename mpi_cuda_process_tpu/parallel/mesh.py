@@ -1,0 +1,78 @@
+"""Device mesh construction and multi-process bootstrap.
+
+TPU-native replacement for the reference's MPI process runtime
+(``MPI_Init``/``Comm_size``/``Comm_rank``/``Finalize`` — kernel.cu:171-178,281).
+Where the reference hard-codes exactly 2 ranks splitting one axis (every
+``size/2`` in kernel.cu), here an N-D :class:`jax.sharding.Mesh` over spatial
+axis names carries arbitrary per-axis shard counts, and there is no per-rank
+code at all: pjit/shard_map programs are single-controller SPMD.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Spatial mesh axis names, aligned with grid axes 0..ndim-1.
+SPATIAL_AXES: Tuple[str, ...] = ("sx", "sy", "sz")
+
+
+def spatial_axis_names(ndim: int) -> Tuple[str, ...]:
+    return SPATIAL_AXES[:ndim]
+
+
+def make_mesh(
+    mesh_shape: Sequence[int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh whose axes 0..n-1 decompose grid axes 0..n-1.
+
+    ``mesh_shape`` is per-grid-axis shard counts, e.g. ``(2, 2)`` for the
+    BASELINE.json config-3 decomposition.  Trailing grid axes beyond
+    ``len(mesh_shape)`` are unsharded.
+    """
+    mesh_shape = tuple(int(s) for s in mesh_shape)
+    n = int(np.prod(mesh_shape))
+    if devices is None:
+        devices = jax.devices()
+    if n > len(devices):
+        raise ValueError(
+            f"mesh {mesh_shape} needs {n} devices, have {len(devices)}"
+        )
+    names = spatial_axis_names(len(mesh_shape))
+    dev_array = np.asarray(devices[:n]).reshape(mesh_shape)
+    return Mesh(dev_array, names)
+
+
+def bootstrap_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    init_timeout_s: int = 300,
+) -> bool:
+    """Initialize multi-host JAX if a cluster is configured; else no-op.
+
+    The fail-fast replacement for the reference's unchecked MPI bootstrap
+    (SURVEY.md §5.3): initialization errors/timeouts raise immediately instead
+    of a peer hanging forever in a blocking recv (kernel.cu:215).
+
+    Returns True iff ``jax.distributed`` was initialized by this call.
+    """
+    configured = (
+        coordinator_address is not None
+        or os.environ.get("COORDINATOR_ADDRESS")
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    )
+    if not configured:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        initialization_timeout=init_timeout_s,
+    )
+    return True

@@ -1,0 +1,4 @@
+from .init import init_state
+from .render import ascii_render, save_npy
+
+__all__ = ["ascii_render", "init_state", "save_npy"]

@@ -1,0 +1,90 @@
+"""State initialization.
+
+Capability parity with the reference's ``create_universe`` functions:
+randomized alive-with-probability init for Life (kernel.cu:131-146, prob 0.15
+at kernel.cu:193) and Dirichlet-wall init for heat (MDF_kernel.cu:88-99 —
+implementing the *intended* init; as written the MDF grid is never initialized
+due to the arg-order bug at MDF_kernel.cu:146).  Determinism comes from an
+explicit ``jax.random`` key instead of the reference's implicit reliance on
+C ``rand()`` with the default seed (SURVEY.md C8).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..driver import frame_mask
+from ..ops.stencil import Fields, Stencil
+
+
+def _pin_frame(x: jax.Array, value, width: int) -> jax.Array:
+    mask = frame_mask(x.shape, x.shape, (0,) * x.ndim, width)
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+def init_state(
+    stencil: Stencil,
+    grid_shape: Sequence[int],
+    seed: int = 0,
+    density: float = 0.15,
+    kind: str = "auto",
+    periodic: bool = False,
+) -> Fields:
+    """Build the initial fields for ``stencil`` on ``grid_shape``.
+
+    kinds:
+      - ``"random"``: Bernoulli(density) occupancy (Life's create_universe).
+      - ``"zero"``: zero interior with guard-frame walls (MDF's intended init).
+      - ``"pulse"``: centered Gaussian bump (wave models).
+      - ``"auto"``: pick by stencil family.
+    """
+    grid_shape = tuple(int(g) for g in grid_shape)
+    if len(grid_shape) != stencil.ndim:
+        raise ValueError(
+            f"{stencil.name} is {stencil.ndim}D, got grid {grid_shape}"
+        )
+    if kind == "auto":
+        if stencil.name == "life":
+            kind = "random"
+        elif stencil.num_fields == 2:
+            kind = "pulse"
+        else:
+            kind = "zero"
+
+    halo = stencil.halo
+    dtype = stencil.dtype
+    if kind == "random":
+        key = jax.random.PRNGKey(seed)
+        x = jax.random.bernoulli(key, density, grid_shape).astype(dtype)
+        fields = (x,) + tuple(
+            jnp.zeros(grid_shape, dtype) for _ in range(stencil.num_fields - 1)
+        )
+    elif kind == "zero":
+        fields = tuple(
+            jnp.zeros(grid_shape, dtype) for _ in range(stencil.num_fields)
+        )
+    elif kind == "pulse":
+        coords = [
+            (jnp.arange(n, dtype=jnp.float32) - (n - 1) / 2.0) / max(n, 2)
+            for n in grid_shape
+        ]
+        r2 = 0.0
+        for d, c in enumerate(coords):
+            shape = [1] * len(grid_shape)
+            shape[d] = grid_shape[d]
+            r2 = r2 + (c.reshape(shape)) ** 2
+        u = jnp.exp(-r2 / (2 * 0.05**2)).astype(dtype)
+        # zero initial velocity: u_prev = u
+        fields = (u,) + tuple(u for _ in range(stencil.num_fields - 1))
+    else:
+        raise ValueError(f"unknown init kind {kind!r}")
+
+    if periodic:
+        # No guard frame exists in periodic mode — every cell is ordinary.
+        return fields
+    return tuple(
+        _pin_frame(f, v, halo) for f, v in zip(fields, stencil.bc_value)
+    )

@@ -1,0 +1,41 @@
+"""Grid rendering and array dumps for inspection.
+
+Capability parity with the reference's ASCII renderer ``print_array``
+(kernel.cu:115-129, duplicated at MDF_kernel.cu:72-86): ``"0"`` for alive,
+space for dead, one line per row.  Unlike the reference's (its MDF copy keeps
+the ``int[]`` signature and can never print the float grid — SURVEY.md C7),
+this one handles both int occupancy grids and float fields (rendered as a
+value ramp), plus 3D grids via a mid-plane slice, and adds ``.npy`` dumps.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_RAMP = " .:-=+*#%@"
+
+
+def ascii_render(arr, max_cells: int = 120) -> str:
+    """Render a grid (2D, or 3D via its middle z-slice) as ASCII art."""
+    a = np.asarray(arr)
+    if a.ndim == 3:
+        a = a[a.shape[0] // 2]
+    if a.ndim != 2:
+        raise ValueError(f"cannot render ndim={a.ndim}")
+    # Subsample very large grids so the render stays terminal-sized.
+    sy = max(1, a.shape[0] // max_cells)
+    sx = max(1, a.shape[1] // max_cells)
+    a = a[::sy, ::sx]
+    if np.issubdtype(a.dtype, np.integer) or a.dtype == np.bool_:
+        # Reference glyphs: "0" alive, " " dead (kernel.cu:120-125).
+        rows = ["".join("0" if v else " " for v in row) for row in a]
+    else:
+        lo, hi = float(np.min(a)), float(np.max(a))
+        span = (hi - lo) or 1.0
+        q = ((a - lo) / span * (len(_RAMP) - 1)).round().astype(np.int32)
+        rows = ["".join(_RAMP[v] for v in row) for row in q]
+    return "\n".join(rows)
+
+
+def save_npy(path: str, arr) -> None:
+    np.save(path, np.asarray(arr))

@@ -1,0 +1,24 @@
+"""Test configuration: run everything on 8 virtual CPU devices.
+
+The TPU-world equivalent of testing MPI code without mpirun (SURVEY.md §4.4):
+``--xla_force_host_platform_device_count=8`` gives every mesh / sharding /
+ppermute test 8 fake devices on one host.  Must be set before jax imports.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The axon TPU sitecustomize force-selects its platform via jax.config after
+# register(), which overrides JAX_PLATFORMS — override it back to CPU here
+# (before any backend is initialized, so XLA_FLAGS still applies).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+

@@ -1,0 +1,101 @@
+"""CLI / config / checkpoint-resume integration tests (SURVEY.md §5.4, §5.6)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from mpi_cuda_process_tpu.cli import build, config_from_args, run
+from mpi_cuda_process_tpu.config import RunConfig, parse_int_tuple, parse_params
+from mpi_cuda_process_tpu.utils import checkpointing
+
+
+def test_parse_helpers():
+    assert parse_int_tuple("512,512") == (512, 512)
+    assert parse_int_tuple("256x256x256") == (256, 256, 256)
+    assert parse_params(["alpha=0.2", "bc=5", "mode=fast"]) == {
+        "alpha": 0.2, "bc": 5, "mode": "fast"}
+
+
+def test_config_roundtrip():
+    cfg = RunConfig(stencil="heat3d", grid=(8, 8, 8), mesh=(2, 2))
+    import json
+    back = RunConfig.from_dict(json.loads(cfg.to_json()))
+    assert back == cfg
+
+
+def test_cli_args_to_config():
+    cfg = config_from_args([
+        "--stencil", "life", "--grid", "32,32", "--iters", "3",
+        "--mesh", "2,2", "--param", "dtype=int32", "--seed", "5"])
+    assert cfg.stencil == "life" and cfg.mesh == (2, 2) and cfg.seed == 5
+
+
+def test_run_end_to_end_unsharded():
+    cfg = RunConfig(stencil="heat2d", grid=(16, 16), iters=5)
+    fields, mcells = run(cfg)
+    assert np.asarray(fields[0]).shape == (16, 16)
+    assert mcells > 0
+
+
+def test_run_end_to_end_sharded():
+    cfg = RunConfig(stencil="life", grid=(16, 16), iters=4, mesh=(2, 2),
+                    params={"dtype": "int32"})
+    fields, _ = run(cfg)
+    ref = run(RunConfig(stencil="life", grid=(16, 16), iters=4,
+                        params={"dtype": "int32"}))[0]
+    np.testing.assert_array_equal(np.asarray(fields[0]), np.asarray(ref[0]))
+
+
+def test_checkpoint_resume_bitmatch(tmp_path):
+    """A resumed run must bit-match an uninterrupted one (SURVEY.md §5.4)."""
+    ck = str(tmp_path / "ckpt")
+    base = dict(stencil="life", grid=(16, 16), iters=10, seed=3,
+                params={"dtype": "int32"})
+    full, _ = run(RunConfig(**base))
+
+    # interrupted at step 6 (checkpoint_every=3 -> checkpoints at 3, 6, 9, 10)
+    run(RunConfig(**{**base, "iters": 6},
+                  checkpoint_every=3, checkpoint_dir=ck))
+    assert checkpointing.latest_step(ck) == 6
+    resumed, _ = run(RunConfig(**base, checkpoint_dir=ck, resume=True,
+                               checkpoint_every=3))
+    np.testing.assert_array_equal(
+        np.asarray(resumed[0]), np.asarray(full[0]))
+
+
+def test_checkpoint_atomic_roundtrip(tmp_path):
+    p = str(tmp_path / "c")
+    f = (jnp.arange(12, dtype=jnp.float32).reshape(3, 4),)
+    checkpointing.save_checkpoint(p, f, 7, {"a": 1})
+    fields, step, cfg = checkpointing.load_checkpoint(p)
+    assert step == 7 and cfg == {"a": 1}
+    np.testing.assert_array_equal(fields[0], np.asarray(f[0]))
+    # overwrite is atomic (directory replaced, not merged)
+    checkpointing.save_checkpoint(p, f, 9)
+    assert checkpointing.latest_step(p) == 9
+
+
+def test_resume_from_nonmultiple_step_keeps_checkpointing(tmp_path):
+    """Resumed runs must keep the absolute checkpoint cadence (not stall)."""
+    ck = str(tmp_path / "ck2")
+    base = dict(stencil="heat2d", grid=(16, 16), params={})
+    # First run ends at step 10 (not a multiple of 4), checkpoints at 4, 8, 10.
+    run(RunConfig(**base, iters=10, checkpoint_every=4, checkpoint_dir=ck))
+    assert checkpointing.latest_step(ck) == 10
+    # Resume to 20: periodic checkpoints must fire again (12, 16, 20).
+    seen = []
+    orig = checkpointing.save_checkpoint
+
+    def spy(path, fields, step, config=None):
+        seen.append(step)
+        return orig(path, fields, step, config)
+
+    import mpi_cuda_process_tpu.cli as cli_mod
+    old = cli_mod.checkpointing.save_checkpoint
+    cli_mod.checkpointing.save_checkpoint = spy
+    try:
+        run(RunConfig(**base, iters=20, checkpoint_every=4,
+                      checkpoint_dir=ck, resume=True))
+    finally:
+        cli_mod.checkpointing.save_checkpoint = old
+    assert 12 in seen and 16 in seen and checkpointing.latest_step(ck) == 20

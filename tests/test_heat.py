@@ -1,0 +1,45 @@
+"""Physics-property tests for the diffusion models (SURVEY.md §4.1)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from mpi_cuda_process_tpu import init_state, make_step, make_stencil, run_simulation
+
+
+def test_hot_walls_reach_uniform_steady_state():
+    """MDF's analytic steady state: all-100 with hot Dirichlet walls."""
+    st = make_stencil("heat2d", bc=100.0)
+    fields = init_state(st, (16, 16), kind="zero")
+    assert float(fields[0][0, 0]) == 100.0  # wall
+    assert float(fields[0][5, 5]) == 0.0  # interior
+    out = run_simulation(st, fields, 3000)
+    np.testing.assert_allclose(np.asarray(out[0]), 100.0, atol=1e-2)
+
+
+def test_maximum_principle():
+    """Diffusion never exceeds the initial/boundary extrema."""
+    rng = np.random.default_rng(0)
+    g = (rng.random((12, 12, 12)) * 100).astype(np.float32)
+    st = make_stencil("heat3d")
+    lo, hi = float(g.min()), float(g.max())
+    out = run_simulation(st, (jnp.asarray(g),), 50)
+    a = np.asarray(out[0])
+    assert a.min() >= lo - 1e-3 and a.max() <= hi + 1e-3
+
+
+def test_heat27_smooths_toward_walls():
+    st = make_stencil("heat3d27", bc=100.0, alpha=0.1)
+    fields = init_state(st, (10, 10, 10), kind="zero")
+    out = run_simulation(st, fields, 500)
+    a = np.asarray(out[0])
+    assert a.min() > 50.0  # well on the way to uniform 100
+
+
+def test_wave_energy_bounded():
+    st = make_stencil("wave3d", c2dt2=0.1)
+    fields = init_state(st, (16, 16, 16), kind="pulse")
+    out = run_simulation(st, fields, 100)
+    a = np.asarray(out[0])
+    assert np.isfinite(a).all()
+    assert np.abs(a).max() < 10.0  # stable, no blow-up

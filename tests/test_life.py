@@ -1,0 +1,52 @@
+"""Game of Life structural tests: still lifes, oscillators, spaceships."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from mpi_cuda_process_tpu import make_step, make_stencil
+
+
+def _grid(shape, coords):
+    g = np.zeros(shape, np.int32)
+    for y, x in coords:
+        g[y, x] = 1
+    return g
+
+
+def _steps(g, n):
+    st = make_stencil("life")
+    step = make_step(st, g.shape)
+    f = (jnp.asarray(g),)
+    for _ in range(n):
+        f = step(f)
+    return np.asarray(f[0])
+
+
+def test_block_still_life():
+    g = _grid((8, 8), [(3, 3), (3, 4), (4, 3), (4, 4)])
+    np.testing.assert_array_equal(_steps(g, 5), g)
+
+
+def test_blinker_oscillates():
+    h = _grid((7, 7), [(3, 2), (3, 3), (3, 4)])
+    v = _grid((7, 7), [(2, 3), (3, 3), (4, 3)])
+    np.testing.assert_array_equal(_steps(h, 1), v)
+    np.testing.assert_array_equal(_steps(h, 2), h)
+
+
+def test_glider_translates():
+    glider = [(1, 2), (2, 3), (3, 1), (3, 2), (3, 3)]
+    g = _grid((12, 12), glider)
+    out = _steps(g, 4)
+    want = _grid((12, 12), [(y + 1, x + 1) for y, x in glider])
+    np.testing.assert_array_equal(out, want)
+
+
+def test_dead_frame_kills_edge_growth():
+    """The guard frame is dead and stays dead (kernel.cu:137-138 semantics)."""
+    g = np.ones((6, 6), np.int32)
+    g[0, :] = g[-1, :] = g[:, 0] = g[:, -1] = 0
+    out = _steps(g, 3)
+    assert out[0, :].sum() == 0 and out[:, 0].sum() == 0
+    assert out[-1, :].sum() == 0 and out[:, -1].sum() == 0

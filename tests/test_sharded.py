@@ -1,0 +1,134 @@
+"""The core distributed invariant the reference never tests (SURVEY.md §4.3):
+
+    sharded step over any mesh  ==  unsharded single-device step
+
+bit-exact for the int Life grid, to float tolerance for the diffusion models.
+Runs on 8 virtual CPU devices (conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mpi_cuda_process_tpu import (
+    init_state,
+    make_mesh,
+    make_sharded_step,
+    make_step,
+    make_stencil,
+    shard_fields,
+)
+
+
+def _compare(name, grid, mesh_shape, steps=5, periodic=False, **params):
+    st = make_stencil(name, **params)
+    fields = init_state(st, grid, seed=7, density=0.3,
+                        kind="random" if name == "life" else "auto")
+    ref_step = make_step(st, grid)
+    ref = fields
+    for _ in range(steps):
+        ref = ref_step(ref)
+
+    mesh = make_mesh(mesh_shape)
+    sh_step = make_sharded_step(st, mesh, grid, periodic=periodic)
+    got = shard_fields(fields, mesh, st.ndim)
+    for _ in range(steps):
+        got = sh_step(got)
+
+    for r, g in zip(ref, got):
+        if np.issubdtype(np.asarray(r).dtype, np.integer):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+        else:
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(r), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("mesh_shape", [(2,), (4,), (8,), (2, 2), (2, 4), (4, 2)])
+def test_life_sharded_bitexact(mesh_shape):
+    _compare("life", (16, 24), mesh_shape, steps=6)
+
+
+@pytest.mark.parametrize("mesh_shape", [(2,), (2, 2), (4, 2)])
+def test_heat2d_sharded(mesh_shape):
+    _compare("heat2d", (16, 16), mesh_shape)
+
+
+@pytest.mark.parametrize("mesh_shape", [(2,), (2, 2), (2, 2, 2), (1, 2, 4)])
+def test_heat3d_sharded(mesh_shape):
+    _compare("heat3d", (8, 8, 8), mesh_shape)
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 2), (2, 2, 2)])
+def test_heat27_sharded_corners(mesh_shape):
+    """27-point needs diagonal halo data — exercises the two-pass exchange."""
+    _compare("heat3d27", (8, 8, 8), mesh_shape, alpha=0.1)
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 2), (2, 2, 2)])
+def test_wave_sharded(mesh_shape):
+    _compare("wave3d", (8, 8, 8), mesh_shape, c2dt2=0.1)
+
+
+def test_nondivisible_grid_rejected():
+    st = make_stencil("heat2d")
+    mesh = make_mesh((2,))
+    with pytest.raises(ValueError, match="not divisible"):
+        make_sharded_step(st, mesh, (15, 16))
+
+
+def test_life_periodic_sharded_matches_roll():
+    """Periodic BCs across shard boundaries: compare against jnp.roll step."""
+    st = make_stencil("life")
+    rng = np.random.default_rng(3)
+    g = rng.integers(0, 2, (8, 8)).astype(np.int32)
+
+    def roll_step(x):
+        n = sum(
+            np.roll(x, (dy, dx), (0, 1))
+            for dy in (-1, 0, 1) for dx in (-1, 0, 1)
+            if (dy, dx) != (0, 0)
+        )
+        return ((n == 3) | ((n == 2) & (x == 1))).astype(np.int32)
+
+    want = g
+    for _ in range(4):
+        want = roll_step(want)
+
+    mesh = make_mesh((2, 2))
+    step = make_sharded_step(st, mesh, (8, 8), periodic=True)
+    got = shard_fields((jnp.asarray(g),), mesh, 2)
+    for _ in range(4):
+        got = step(got)
+    np.testing.assert_array_equal(np.asarray(got[0]), want)
+
+
+def test_life_periodic_unsharded_matches_roll():
+    """--periodic must be honored on the single-device path too."""
+    st = make_stencil("life")
+    rng = np.random.default_rng(9)
+    g = rng.integers(0, 2, (8, 8)).astype(np.int32)
+
+    def roll_step(x):
+        n = sum(
+            np.roll(x, (dy, dx), (0, 1))
+            for dy in (-1, 0, 1) for dx in (-1, 0, 1)
+            if (dy, dx) != (0, 0)
+        )
+        return ((n == 3) | ((n == 2) & (x == 1))).astype(np.int32)
+
+    want = g
+    for _ in range(4):
+        want = roll_step(want)
+    step = make_step(st, (8, 8), periodic=True)
+    got = (jnp.asarray(g),)
+    for _ in range(4):
+        got = step(got)
+    np.testing.assert_array_equal(np.asarray(got[0]), want)
+
+
+def test_wave_skips_uprev_exchange_but_stays_correct():
+    """u_prev has field_halo 0 (no exchange) and results still match."""
+    st = make_stencil("wave3d", c2dt2=0.1)
+    assert st.field_halos == (1, 0)
+    _compare("wave3d", (8, 8, 8), (2, 2), c2dt2=0.1)
