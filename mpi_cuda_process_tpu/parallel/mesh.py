@@ -48,6 +48,31 @@ def make_mesh(
     return Mesh(dev_array, names)
 
 
+def factor_mesh(n_devices: int, ndim: int) -> Tuple[int, ...]:
+    """Factor ``n_devices`` into a balanced ndim-axis mesh shape.
+
+    E.g. (8, 3) -> (2, 2, 2); (4, 2) -> (2, 2); (6, 3) -> (3, 2, 1) -> trimmed
+    of trailing 1s is fine to keep, callers may pass it straight to make_mesh.
+    Balanced splits minimize halo surface per shard (SURVEY.md §5.7).
+    """
+    shape = [1] * ndim
+    remaining = n_devices
+    # peel off prime factors largest-first onto the currently-smallest axis
+    f = 2
+    factors = []
+    while remaining > 1 and f * f <= remaining:
+        while remaining % f == 0:
+            factors.append(f)
+            remaining //= f
+        f += 1
+    if remaining > 1:
+        factors.append(remaining)
+    for p in sorted(factors, reverse=True):
+        i = shape.index(min(shape))
+        shape[i] *= p
+    return tuple(shape)
+
+
 def bootstrap_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
