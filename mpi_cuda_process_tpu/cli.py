@@ -68,6 +68,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ASCII-render the final grid")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace for the run")
+    p.add_argument("--compute", default="auto",
+                   choices=["auto", "jnp", "pallas"],
+                   help="local block update implementation (auto: jnp for "
+                        "7-point-class stencils where XLA fuses to roofline, "
+                        "pallas where the hand kernel wins)")
     return p
 
 
@@ -79,8 +84,28 @@ def config_from_args(argv=None) -> RunConfig:
         periodic=a.periodic, log_every=a.log_every,
         checkpoint_every=a.checkpoint_every, checkpoint_dir=a.checkpoint_dir,
         resume=a.resume, render=a.render, profile_dir=a.profile_dir,
-        params=parse_params(a.param),
+        compute=a.compute, params=parse_params(a.param),
     )
+
+
+# Stencils whose Pallas kernel beats XLA's fusion on TPU (measured); all
+# others fuse to ~HBM roofline already and default to the jnp path.
+_PALLAS_WINS = {"heat3d27"}
+
+
+def resolve_compute_fn(cfg: RunConfig, st):
+    from .ops.pallas import has_pallas_kernel, make_pallas_compute
+
+    mode = cfg.compute
+    if mode == "auto":
+        use = st.name in _PALLAS_WINS and jax.default_backend() == "tpu"
+    elif mode == "pallas":
+        if not has_pallas_kernel(st.name):
+            raise ValueError(f"no pallas kernel for {st.name!r}")
+        use = True
+    else:
+        use = False
+    return make_pallas_compute(st) if use else None
 
 
 def build(cfg: RunConfig):
@@ -100,13 +125,15 @@ def build(cfg: RunConfig):
         fields = init_state(st, cfg.grid, cfg.seed, cfg.density, cfg.init,
                             periodic=cfg.periodic)
 
+    compute_fn = resolve_compute_fn(cfg, st)
     if cfg.mesh and math.prod(cfg.mesh) > 1:
         m = mesh_lib.make_mesh(cfg.mesh)
         step_fn = stepper_lib.make_sharded_step(
-            st, m, cfg.grid, periodic=cfg.periodic)
+            st, m, cfg.grid, periodic=cfg.periodic, compute_fn=compute_fn)
         fields = stepper_lib.shard_fields(fields, m, st.ndim)
     else:
-        step_fn = driver.make_step(st, cfg.grid, periodic=cfg.periodic)
+        step_fn = driver.make_step(
+            st, cfg.grid, periodic=cfg.periodic, compute_fn=compute_fn)
     return st, step_fn, fields, start_step
 
 

@@ -32,6 +32,7 @@ class RunConfig:
     resume: bool = False
     render: bool = False
     profile_dir: Optional[str] = None
+    compute: str = "auto"  # auto | jnp | pallas
     params: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:

@@ -50,7 +50,12 @@ def frame_mask(
     return mask
 
 
-def make_step(stencil: Stencil, global_shape: Sequence[int], periodic: bool = False):
+def make_step(
+    stencil: Stencil,
+    global_shape: Sequence[int],
+    periodic: bool = False,
+    compute_fn=None,
+):
     """Single-device step function: pad -> update -> re-pin frame.
 
     Guard-frame mode (default): padding uses the stencil's guard-cell
@@ -60,9 +65,13 @@ def make_step(stencil: Stencil, global_shape: Sequence[int], periodic: bool = Fa
     — including non-constant frames set by init — honored).
 
     Periodic mode: wrap padding, every cell updates, no frame.
+
+    ``compute_fn`` overrides the local update (padded fields -> interior
+    fields) — the hook through which Pallas kernels replace the jnp ops.
     """
     ndim = stencil.ndim
     zeros = (0,) * ndim
+    update = compute_fn or stencil.update
 
     def step(fields: Fields) -> Fields:
         padded = []
@@ -75,11 +84,21 @@ def make_step(stencil: Stencil, global_shape: Sequence[int], periodic: bool = Fa
                 padded.append(
                     jnp.pad(f, fh, constant_values=jnp.asarray(v, f.dtype))
                 )
-        new = stencil.update(tuple(padded))
-        if periodic:
-            return tuple(new)
-        mask = frame_mask(fields[0].shape, global_shape, zeros, stencil.halo)
-        return tuple(jnp.where(mask, f, nf) for f, nf in zip(fields, new))
+        new = update(tuple(padded))
+        mask = None
+        out = []
+        for i, nf in enumerate(new):
+            j = stencil.carry_map[i]
+            if j is not None:
+                out.append(fields[j])  # verbatim carry: no compute, no copy
+            elif periodic or not stencil.mask_fields[i]:
+                out.append(nf)
+            else:
+                if mask is None:
+                    mask = frame_mask(
+                        fields[0].shape, global_shape, zeros, stencil.halo)
+                out.append(jnp.where(mask, fields[i], nf))
+        return tuple(out)
 
     return step
 

@@ -1,0 +1,3 @@
+from .kernels import has_pallas_kernel, make_pallas_compute
+
+__all__ = ["has_pallas_kernel", "make_pallas_compute"]

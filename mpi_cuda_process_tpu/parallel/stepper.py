@@ -91,20 +91,30 @@ def make_sharded_step(
                 fields, stencil.bc_value, stencil.field_halos)
         )
         new = update(padded)
-        if periodic:
-            return tuple(new)
-        offsets = tuple(
-            lax.axis_index(n) * ls if n else 0
-            for n, ls in zip(axis_names, local_shape)
-        )
-        mask = frame_mask(local_shape, global_shape, offsets, halo)
-        return tuple(
-            jnp.where(mask, f, nf) for f, nf in zip(fields, new)
-        )
+        mask = None
+        out = []
+        for i, nf in enumerate(new):
+            j = stencil.carry_map[i]
+            if j is not None:
+                out.append(fields[j])  # verbatim carry: no compute, no copy
+            elif periodic or not stencil.mask_fields[i]:
+                out.append(nf)
+            else:
+                if mask is None:
+                    offsets = tuple(
+                        lax.axis_index(n) * ls if n else 0
+                        for n, ls in zip(axis_names, local_shape)
+                    )
+                    mask = frame_mask(local_shape, global_shape, offsets, halo)
+                out.append(jnp.where(mask, fields[i], nf))
+        return tuple(out)
 
+    # check_vma=False: pallas_call outputs carry no varying-mesh-axes
+    # annotation, which the default vma check rejects inside shard_map.
     return shard_map(
         local_step,
         mesh=mesh,
         in_specs=(spec,),
         out_specs=spec,
+        check_vma=False,
     )

@@ -1,0 +1,74 @@
+"""Pallas kernels vs jnp reference ops, in interpret mode on CPU
+(SURVEY.md §4.4): the same kernel code that runs on TPU, executed by the
+Pallas interpreter, must match the jnp update exactly.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mpi_cuda_process_tpu import make_step, make_stencil
+from mpi_cuda_process_tpu.ops.pallas import has_pallas_kernel, make_pallas_compute
+
+
+CASES = [
+    ("heat2d", (12, 18), {}),
+    ("life", (10, 12), {}),
+    ("heat3d", (16, 8, 10), {}),       # z divisible by a chunk size
+    ("heat3d", (6, 8, 10), {}),        # z NOT divisible: jnp fallback path
+    ("heat3d27", (16, 7, 8), {"alpha": 0.1}),
+    ("wave3d", (16, 8, 8), {"c2dt2": 0.1}),
+]
+
+
+def _random_fields(st, grid, seed=0):
+    rng = np.random.default_rng(seed)
+    if st.name == "life":
+        f = rng.integers(0, 2, grid).astype(np.int32)
+        return (jnp.asarray(f),)
+    fields = [rng.random(grid).astype(np.float32) * 10
+              for _ in range(st.num_fields)]
+    return tuple(jnp.asarray(f) for f in fields)
+
+
+@pytest.mark.parametrize("name,grid,params", CASES)
+def test_pallas_matches_jnp(name, grid, params):
+    st = make_stencil(name, **params)
+    assert has_pallas_kernel(name)
+    fields = _random_fields(st, grid)
+    ref_step = make_step(st, grid)
+    pl_step = make_step(st, grid, compute_fn=make_pallas_compute(st))
+    ref, got = fields, fields
+    for _ in range(2):
+        ref = ref_step(ref)
+        got = pl_step(got)
+    for r, g in zip(ref, got):
+        if np.issubdtype(np.asarray(r).dtype, np.integer):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+        else:
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(r), rtol=1e-6, atol=1e-6)
+
+
+def test_pallas_in_sharded_step():
+    """Pallas compute_fn plugs into the shard_map stepper unchanged."""
+    from mpi_cuda_process_tpu import (
+        init_state, make_mesh, make_sharded_step, shard_fields)
+
+    st = make_stencil("heat3d")
+    grid = (16, 8, 8)
+    fields = init_state(st, grid, kind="zero")
+    mesh = make_mesh((1, 2, 2))  # z unsharded so chunking sees full z
+    ref = make_step(st, grid)(fields)
+    step = make_sharded_step(
+        st, mesh, grid, compute_fn=make_pallas_compute(st))
+    got = step(shard_fields(fields, mesh, 3))
+    np.testing.assert_allclose(
+        np.asarray(got[0]), np.asarray(ref[0]), rtol=1e-6, atol=1e-6)
+
+
+def test_unknown_stencil_raises():
+    st = make_stencil("wave2d")
+    with pytest.raises(KeyError, match="no pallas kernel"):
+        make_pallas_compute(st)
