@@ -31,7 +31,7 @@ from .ops import stencil as stencil_lib
 from .ops import heat, life, wave  # noqa: F401  (populate the registry)
 from .parallel import mesh as mesh_lib
 from .parallel import stepper as stepper_lib
-from .utils import checkpointing, render
+from .utils import checkpointing, diagnostics, render
 from .utils.init import init_state
 
 log = logging.getLogger("mpi_cuda_process_tpu")
@@ -68,6 +68,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ASCII-render the final grid")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace for the run")
+    p.add_argument("--ensemble", type=int, default=0,
+                   help="run N independent universes batched via vmap "
+                        "(seeds seed..seed+N-1)")
     p.add_argument("--compute", default="auto",
                    choices=["auto", "jnp", "pallas"],
                    help="local block update implementation (auto: jnp for "
@@ -84,7 +87,8 @@ def config_from_args(argv=None) -> RunConfig:
         periodic=a.periodic, log_every=a.log_every,
         checkpoint_every=a.checkpoint_every, checkpoint_dir=a.checkpoint_dir,
         resume=a.resume, render=a.render, profile_dir=a.profile_dir,
-        compute=a.compute, params=parse_params(a.param),
+        compute=a.compute, ensemble=a.ensemble,
+        params=parse_params(a.param),
     )
 
 
@@ -123,9 +127,16 @@ def build(cfg: RunConfig):
         log.info("resumed from %s at step %d", cfg.checkpoint_dir, start_step)
     else:
         fields = init_state(st, cfg.grid, cfg.seed, cfg.density, cfg.init,
-                            periodic=cfg.periodic)
+                            periodic=cfg.periodic, ensemble=cfg.ensemble)
 
     compute_fn = resolve_compute_fn(cfg, st)
+    if cfg.ensemble and cfg.mesh and math.prod(cfg.mesh) > 1:
+        raise ValueError("--ensemble currently excludes --mesh; "
+                         "use one batching strategy at a time")
+    if cfg.ensemble:
+        step_fn = driver.make_ensemble_step(driver.make_step(
+            st, cfg.grid, periodic=cfg.periodic, compute_fn=compute_fn))
+        return st, step_fn, fields, start_step
     if cfg.mesh and math.prod(cfg.mesh) > 1:
         m = mesh_lib.make_mesh(cfg.mesh)
         step_fn = stepper_lib.make_sharded_step(
@@ -146,13 +157,13 @@ def run(cfg: RunConfig) -> Tuple:
         log.info("checkpoint already at step %d >= iters", start_step)
         return fields, 0.0
 
-    cells = math.prod(cfg.grid)
+    cells = math.prod(cfg.grid) * max(1, cfg.ensemble)
 
     def callback(done_in_run, fs):
         step = start_step + done_in_run
         if cfg.log_every and step % cfg.log_every == 0:
-            diag = float(jnp.sum(fs[0]))
-            log.info("step %d  sum(field0)=%.6g", step, diag)
+            d = diagnostics.field_diagnostics(st, fs)
+            log.info("step %d  %s", step, diagnostics.format_diagnostics(d))
         if cfg.checkpoint_every and cfg.checkpoint_dir and \
                 step % cfg.checkpoint_every == 0:
             checkpointing.save_checkpoint(

@@ -33,6 +33,7 @@ class RunConfig:
     render: bool = False
     profile_dir: Optional[str] = None
     compute: str = "auto"  # auto | jnp | pallas
+    ensemble: int = 0  # >0: batch of independent universes via vmap
     params: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:

@@ -103,6 +103,17 @@ def make_step(
     return step
 
 
+def make_ensemble_step(step_fn):
+    """Vectorize a step over a leading batch axis of independent simulations.
+
+    The data-parallel analogue for stencil workloads (SURVEY.md §2.2): the
+    reference has no batch dimension; here ``vmap`` runs N universes per
+    device in one fused program (and composes with the sharded stepper for
+    batch-of-sharded-grids).
+    """
+    return jax.vmap(step_fn)
+
+
 def make_runner(step_fn, n_steps: int, jit: bool = True):
     """Wrap ``step_fn`` in a donated, jitted ``lax.scan`` over ``n_steps``.
 

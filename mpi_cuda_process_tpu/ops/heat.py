@@ -58,6 +58,47 @@ def heat3d(alpha=1.0 / 6.0, bc=100.0, dtype=jnp.float32) -> Stencil:
     )
 
 
+def _make_lap4th_update(ndim, alpha):
+    # 4th-order central second derivative per axis:
+    # u'' ~ (-u[-2] + 16 u[-1] - 30 u[0] + 16 u[+1] - u[+2]) / 12
+    w = {1: 16.0 / 12.0, 2: -1.0 / 12.0}
+    c = -30.0 / 12.0 * ndim
+
+    def update(padded):
+        (p,) = padded
+        u = interior(p, 2, ndim)
+        acc = c * u
+        for d in range(ndim):
+            for dist in (1, 2):
+                for s in (-dist, dist):
+                    off = [0] * ndim
+                    off[d] = s
+                    acc = acc + w[dist] * shifted(p, tuple(off), 2)
+        return (u + alpha * acc,)
+
+    return update
+
+
+@register("heat3d4th")
+def heat3d4th(alpha=0.1, bc=100.0, dtype=jnp.float32) -> Stencil:
+    """3D 4th-order (13-point, halo 2) Laplacian diffusion.
+
+    Exercises halo width k > 1 end-to-end: the reference is hard-wired to a
+    1-row halo (kernel.cu:97-105); here ``halo=2`` flows through padding,
+    guard frame, and the width-k ppermute slab exchange unchanged.
+    """
+    return Stencil(
+        name="heat3d4th",
+        ndim=3,
+        halo=2,
+        num_fields=1,
+        dtype=jnp.dtype(dtype),
+        bc_value=(bc,),
+        update=_make_lap4th_update(3, alpha),
+        params={"alpha": alpha, "bc": bc},
+    )
+
+
 # Isotropic 27-point Laplacian weights (x 1/30): faces 14, edges 3, corners 1,
 # center -128.  Second moments per axis sum to 2 => consistent with the 7-point
 # Laplacian but with O(h^2) error isotropic in direction.

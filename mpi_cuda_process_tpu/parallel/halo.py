@@ -105,6 +105,7 @@ def exchange_and_pad(
     """
     if halo == 0:
         return x
-    for d, (name, cnt) in enumerate(zip(axis_names, shard_counts)):
-        x = exchange_pad_axis(x, d, name, cnt, halo, bc_value, periodic)
+    with jax.named_scope("halo_exchange"):
+        for d, (name, cnt) in enumerate(zip(axis_names, shard_counts)):
+            x = exchange_pad_axis(x, d, name, cnt, halo, bc_value, periodic)
     return x

@@ -90,7 +90,8 @@ def make_sharded_step(
             for f, bc, fh in zip(
                 fields, stencil.bc_value, stencil.field_halos)
         )
-        new = update(padded)
+        with jax.named_scope("stencil_update"):
+            new = update(padded)
         mask = None
         out = []
         for i, nf in enumerate(new):

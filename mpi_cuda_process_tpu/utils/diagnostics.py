@@ -1,0 +1,43 @@
+"""Field diagnostics for logging/observability.
+
+ABSENT in the reference beyond commented-out debug prints (kernel.cu:73, 94,
+197, 232) — SURVEY.md §5.5.  Provides the per-interval quantities the CLI
+logs: Game-of-Life population count, field min/max/mean, and the Jacobi
+residual norm (how far the diffusion state is from its fixed point).  All
+reductions are jnp-level, so on sharded arrays XLA lowers them to per-shard
+reductions + a psum-style cross-device combine over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from ..ops.stencil import Stencil
+
+
+def field_diagnostics(stencil: Stencil, fields) -> Dict[str, float]:
+    f0 = fields[0]
+    out: Dict[str, float] = {}
+    if stencil.name == "life":
+        out["population"] = float(jnp.sum(f0))
+    else:
+        out["mean"] = float(jnp.mean(f0))
+        out["min"] = float(jnp.min(f0))
+        out["max"] = float(jnp.max(f0))
+    if stencil.num_fields > 1:
+        # wave: discrete energy proxy |u - u_prev| (velocity magnitude)
+        out["velocity_l2"] = float(
+            jnp.sqrt(jnp.sum((fields[0] - fields[1]) ** 2)))
+    return out
+
+
+def residual_norm(step_fn, fields) -> float:
+    """L2 norm of one-step change — the Jacobi convergence residual."""
+    new = step_fn(tuple(fields))
+    return float(jnp.sqrt(jnp.sum((new[0] - fields[0]) ** 2)))
+
+
+def format_diagnostics(d: Dict[str, float]) -> str:
+    return "  ".join(f"{k}={v:.6g}" for k, v in d.items())

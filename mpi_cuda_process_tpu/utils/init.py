@@ -32,6 +32,7 @@ def init_state(
     density: float = 0.15,
     kind: str = "auto",
     periodic: bool = False,
+    ensemble: int = 0,
 ) -> Fields:
     """Build the initial fields for ``stencil`` on ``grid_shape``.
 
@@ -40,11 +41,25 @@ def init_state(
       - ``"zero"``: zero interior with guard-frame walls (MDF's intended init).
       - ``"pulse"``: centered Gaussian bump (wave models).
       - ``"auto"``: pick by stencil family.
+
+    ``ensemble > 0`` returns fields with a leading batch axis of that many
+    independently-seeded universes (for the vmapped ensemble stepper).
     """
     grid_shape = tuple(int(g) for g in grid_shape)
     if len(grid_shape) != stencil.ndim:
         raise ValueError(
             f"{stencil.name} is {stencil.ndim}D, got grid {grid_shape}"
+        )
+    if ensemble:
+        # batch of independent universes: stack per-member inits (each with
+        # its own derived seed) along a leading axis
+        members = [
+            init_state(stencil, grid_shape, seed + i, density, kind, periodic)
+            for i in range(ensemble)
+        ]
+        return tuple(
+            jnp.stack([m[f] for m in members])
+            for f in range(stencil.num_fields)
         )
     if kind == "auto":
         if stencil.name == "life":

@@ -82,3 +82,21 @@ def wave_step(u: np.ndarray, u_prev: np.ndarray, c2dt2: float):
         lap = acc - 2 * nd * u[idx]
         new[idx] = 2 * u[idx] - u_prev[idx] + c2dt2 * lap
     return new, u.copy()
+
+
+def heat4th_step(grid: np.ndarray, alpha: float) -> np.ndarray:
+    """4th-order 13-point Laplacian, halo 2; 2-cell frame pinned."""
+    nd = grid.ndim
+    new = grid.copy()
+    it = [range(2, s - 2) for s in grid.shape]
+    w = {1: 16.0 / 12.0, 2: -1.0 / 12.0}
+    for idx in itertools.product(*it):
+        acc = -30.0 / 12.0 * nd * grid[idx]
+        for d in range(nd):
+            for dist in (1, 2):
+                for s in (-dist, dist):
+                    j = list(idx)
+                    j[d] += s
+                    acc += w[dist] * grid[tuple(j)]
+        new[idx] = grid[idx] + alpha * acc
+    return new

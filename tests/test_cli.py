@@ -99,3 +99,22 @@ def test_resume_from_nonmultiple_step_keeps_checkpointing(tmp_path):
     finally:
         cli_mod.checkpointing.save_checkpoint = old
     assert 12 in seen and 16 in seen and checkpointing.latest_step(ck) == 20
+
+
+def test_ensemble_matches_independent_runs():
+    """vmapped ensemble == N independent runs with seeds seed..seed+N-1."""
+    base = dict(stencil="life", grid=(16, 16), iters=5)
+    ens, _ = run(RunConfig(**base, seed=4, ensemble=3))
+    assert np.asarray(ens[0]).shape == (3, 16, 16)
+    for i in range(3):
+        solo, _ = run(RunConfig(**base, seed=4 + i))
+        np.testing.assert_array_equal(
+            np.asarray(ens[0])[i], np.asarray(solo[0]))
+
+
+def test_ensemble_plus_mesh_rejected():
+    import pytest
+    with pytest.raises(ValueError, match="ensemble"):
+        from mpi_cuda_process_tpu.cli import build
+        build(RunConfig(stencil="life", grid=(16, 16), iters=1,
+                        ensemble=2, mesh=(2, 2)))

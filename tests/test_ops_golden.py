@@ -102,3 +102,16 @@ def test_odd_sizes_fully_computed():
     got = np.asarray(_run_steps(st, (jnp.asarray(g),), 1, shape)[0])
     # every interior cell adjacent to the cold frame must have cooled
     assert got[1, 1] < 1.0 and got[-2, -2] < 1.0 and got[-2, 1] < 1.0
+
+
+def test_heat4th_matches_golden():
+    shape = (8, 9, 10)
+    g = _rng(6).random(shape).astype(np.float32) * 10
+    st = make_stencil("heat3d4th", alpha=0.05)
+    got = _run_steps(st, (jnp.asarray(g),), 2, shape)[0]
+    want = g.astype(np.float64)
+    for _ in range(2):
+        want = golden.heat4th_step(want, 0.05)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-4)
+    # halo-2 frame: outer TWO cells pinned
+    np.testing.assert_array_equal(np.asarray(got)[:2], g[:2])

@@ -132,3 +132,9 @@ def test_wave_skips_uprev_exchange_but_stays_correct():
     st = make_stencil("wave3d", c2dt2=0.1)
     assert st.field_halos == (1, 0)
     _compare("wave3d", (8, 8, 8), (2, 2), c2dt2=0.1)
+
+
+@pytest.mark.parametrize("mesh_shape", [(2,), (2, 2), (2, 2, 2)])
+def test_heat4th_halo2_sharded(mesh_shape):
+    """Width-2 halo slabs across shard boundaries (k>1 exchange path)."""
+    _compare("heat3d4th", (8, 8, 8), mesh_shape, alpha=0.05)
