@@ -31,7 +31,9 @@ from .ops import stencil as stencil_lib
 from .ops import heat, life, wave  # noqa: F401  (populate the registry)
 from .parallel import mesh as mesh_lib
 from .parallel import stepper as stepper_lib
-from .utils import checkpointing, diagnostics, render
+import os
+
+from .utils import checkpointing, diagnostics, native, render
 from .utils.init import init_state
 
 log = logging.getLogger("mpi_cuda_process_tpu")
@@ -68,6 +70,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ASCII-render the final grid")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace for the run")
+    p.add_argument("--dump-every", type=int, default=0,
+                   help="async-dump field0 snapshots every N steps (.npy, "
+                        "non-blocking via the native writer pool)")
+    p.add_argument("--dump-dir", default=None)
     p.add_argument("--ensemble", type=int, default=0,
                    help="run N independent universes batched via vmap "
                         "(seeds seed..seed+N-1)")
@@ -88,6 +94,7 @@ def config_from_args(argv=None) -> RunConfig:
         checkpoint_every=a.checkpoint_every, checkpoint_dir=a.checkpoint_dir,
         resume=a.resume, render=a.render, profile_dir=a.profile_dir,
         compute=a.compute, ensemble=a.ensemble,
+        dump_every=a.dump_every, dump_dir=a.dump_dir,
         params=parse_params(a.param),
     )
 
@@ -159,6 +166,9 @@ def run(cfg: RunConfig) -> Tuple:
 
     cells = math.prod(cfg.grid) * max(1, cfg.ensemble)
 
+    if cfg.dump_every and cfg.dump_dir:
+        os.makedirs(cfg.dump_dir, exist_ok=True)
+
     def callback(done_in_run, fs):
         step = start_step + done_in_run
         if cfg.log_every and step % cfg.log_every == 0:
@@ -168,11 +178,16 @@ def run(cfg: RunConfig) -> Tuple:
                 step % cfg.checkpoint_every == 0:
             checkpointing.save_checkpoint(
                 cfg.checkpoint_dir, fs, step, dataclasses.asdict(cfg))
+        if cfg.dump_every and cfg.dump_dir and \
+                step % cfg.dump_every == 0:
+            native.async_write_npy(
+                os.path.join(cfg.dump_dir, f"step_{step:08d}.npy"),
+                np.asarray(fs[0]))
 
-    interval = 0
-    if cfg.log_every or cfg.checkpoint_every:
-        opts = [v for v in (cfg.log_every, cfg.checkpoint_every) if v]
-        interval = math.gcd(*opts) if len(opts) > 1 else opts[0]
+    intervals = [v for v in (cfg.log_every, cfg.checkpoint_every,
+                             cfg.dump_every if cfg.dump_dir else 0) if v]
+    interval = math.gcd(*intervals) if len(intervals) > 1 else (
+        intervals[0] if intervals else 0)
 
     ctx = None
     if cfg.profile_dir:
@@ -188,6 +203,8 @@ def run(cfg: RunConfig) -> Tuple:
         if ctx is not None:
             ctx.__exit__(None, None, None)
     dt = time.perf_counter() - t0
+    if cfg.dump_every and cfg.dump_dir:
+        native.wait_all()  # drain the async dump queue; surfaces IO errors
     mcells = cells * remaining / dt / 1e6
 
     if cfg.checkpoint_dir and cfg.checkpoint_every:

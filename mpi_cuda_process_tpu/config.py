@@ -34,6 +34,8 @@ class RunConfig:
     profile_dir: Optional[str] = None
     compute: str = "auto"  # auto | jnp | pallas
     ensemble: int = 0  # >0: batch of independent universes via vmap
+    dump_every: int = 0  # >0: async .npy snapshots of field0 every N steps
+    dump_dir: Optional[str] = None
     params: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:

@@ -31,8 +31,17 @@ def save_checkpoint(path: str, fields, step: int, config: Optional[Dict] = None)
     os.makedirs(parent, exist_ok=True)
     tmp = tempfile.mkdtemp(prefix=".ckpt_tmp_", dir=parent)
     try:
-        for i, f in enumerate(fields):
-            np.save(os.path.join(tmp, f"field_{i}.npy"), f)
+        from . import native
+
+        if native.available():
+            # parallel field writes through the native writer pool
+            for i, f in enumerate(fields):
+                native.async_write_npy(
+                    os.path.join(tmp, f"field_{i}.npy"), f)
+            native.wait_all()
+        else:
+            for i, f in enumerate(fields):
+                np.save(os.path.join(tmp, f"field_{i}.npy"), f)
         meta = {
             "step": int(step),
             "num_fields": len(fields),

@@ -118,3 +118,16 @@ def test_ensemble_plus_mesh_rejected():
         from mpi_cuda_process_tpu.cli import build
         build(RunConfig(stencil="life", grid=(16, 16), iters=1,
                         ensemble=2, mesh=(2, 2)))
+
+
+def test_dump_every_writes_snapshots(tmp_path):
+    d = str(tmp_path / "dumps")
+    run(RunConfig(stencil="heat2d", grid=(16, 16), iters=10,
+                  dump_every=4, dump_dir=d))
+    import os
+    files = sorted(os.listdir(d))
+    assert files == ["step_00000004.npy", "step_00000008.npy",
+                     "step_00000010.npy"] or files == [
+        "step_00000004.npy", "step_00000008.npy"]
+    a = np.load(os.path.join(d, files[0]))
+    assert a.shape == (16, 16)
