@@ -70,6 +70,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ASCII-render the final grid")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace for the run")
+    p.add_argument("--overlap", action="store_true",
+                   help="explicit interior/boundary split so the halo "
+                        "exchange overlaps bulk compute (vs trusting XLA)")
     p.add_argument("--dump-every", type=int, default=0,
                    help="async-dump field0 snapshots every N steps (.npy, "
                         "non-blocking via the native writer pool)")
@@ -93,7 +96,7 @@ def config_from_args(argv=None) -> RunConfig:
         periodic=a.periodic, log_every=a.log_every,
         checkpoint_every=a.checkpoint_every, checkpoint_dir=a.checkpoint_dir,
         resume=a.resume, render=a.render, profile_dir=a.profile_dir,
-        compute=a.compute, ensemble=a.ensemble,
+        compute=a.compute, overlap=a.overlap, ensemble=a.ensemble,
         dump_every=a.dump_every, dump_dir=a.dump_dir,
         params=parse_params(a.param),
     )
@@ -147,7 +150,8 @@ def build(cfg: RunConfig):
     if cfg.mesh and math.prod(cfg.mesh) > 1:
         m = mesh_lib.make_mesh(cfg.mesh)
         step_fn = stepper_lib.make_sharded_step(
-            st, m, cfg.grid, periodic=cfg.periodic, compute_fn=compute_fn)
+            st, m, cfg.grid, periodic=cfg.periodic, compute_fn=compute_fn,
+            overlap=cfg.overlap)
         fields = stepper_lib.shard_fields(fields, m, st.ndim)
     else:
         step_fn = driver.make_step(

@@ -33,6 +33,7 @@ class RunConfig:
     render: bool = False
     profile_dir: Optional[str] = None
     compute: str = "auto"  # auto | jnp | pallas
+    overlap: bool = False  # explicit interior/boundary split for comm overlap
     ensemble: int = 0  # >0: batch of independent universes via vmap
     dump_every: int = 0  # >0: async .npy snapshots of field0 every N steps
     dump_dir: Optional[str] = None

@@ -53,6 +53,7 @@ def make_sharded_step(
     global_shape: Sequence[int],
     periodic: bool = False,
     compute_fn: Optional[Callable[[Fields], Fields]] = None,
+    overlap: bool = False,
 ):
     """Build the SPMD step function for ``stencil`` decomposed over ``mesh``.
 
@@ -60,6 +61,17 @@ def make_sharded_step(
     fields); defaults to ``stencil.update``.  This is the hook through which
     Pallas kernels replace the jnp reference ops without touching any of the
     decomposition machinery.
+
+    ``overlap=True`` selects the explicit interior/boundary split — the
+    TPU-native re-design of the reference's two-CUDA-stream overlap trick
+    (middle kernel on one stream concurrent with the MPI halo wait,
+    kernel.cu:209-221; SURVEY.md §7.3.1 option (b)): the bulk update is
+    computed from a *locally* padded block with no data dependency on the
+    ``ppermute`` results, so XLA's async scheduler can run the collective
+    concurrently with it; only the width-``halo`` boundary ring is computed
+    from exchanged data and spliced over the bulk result.  With
+    ``overlap=False`` (default, option (a)) the whole block update consumes
+    the exchanged padding and overlap is left entirely to XLA.
     """
     ndim = stencil.ndim
     halo = stencil.halo
@@ -84,14 +96,62 @@ def make_sharded_step(
     update = compute_fn or stencil.update
     spec = grid_partition_spec(ndim, mesh)
 
+    sharded_axes = [d for d, c in enumerate(counts) if c > 1]
+    no_names = (None,) * ndim
+
+    def _axis_slice(x, d, sl):
+        idx = [slice(None)] * x.ndim
+        idx[d] = sl
+        return x[tuple(idx)]
+
+    def _ring_update(padded, fields, d, lo: bool):
+        """Update of the width-halo boundary ring at face (d, lo/hi)."""
+        slabs = []
+        for pf, f, fh in zip(padded, fields, stencil.field_halos):
+            if fh == 0:
+                sl = slice(0, halo) if lo else slice(f.shape[d] - halo, None)
+                slabs.append(_axis_slice(f, d, sl))
+            else:
+                sl = slice(0, 3 * fh) if lo else slice(pf.shape[d] - 3 * fh, None)
+                slabs.append(_axis_slice(pf, d, sl))
+        return update(tuple(slabs))
+
     def local_step(fields: Fields) -> Fields:
         padded = tuple(
             exchange_and_pad(f, axis_names, counts, fh, bc, periodic)
             for f, bc, fh in zip(
                 fields, stencil.bc_value, stencil.field_halos)
         )
-        with jax.named_scope("stencil_update"):
-            new = update(padded)
+        if overlap and sharded_axes:
+            # Bulk update from LOCAL padding only — independent of ppermute,
+            # so XLA can overlap the exchange with it (the reference's
+            # middle-stream / border-stream split, kernel.cu:209-221).
+            with jax.named_scope("interior_update"):
+                local_padded = tuple(
+                    exchange_and_pad(f, no_names, (1,) * ndim, fh, bc,
+                                     periodic)
+                    for f, bc, fh in zip(
+                        fields, stencil.bc_value, stencil.field_halos)
+                )
+                bulk = list(update(local_padded))
+            with jax.named_scope("boundary_update"):
+                for d in sharded_axes:
+                    ring_lo = _ring_update(padded, fields, d, True)
+                    ring_hi = _ring_update(padded, fields, d, False)
+                    for i in range(len(bulk)):
+                        if stencil.carry_map[i] is not None:
+                            continue
+                        n_d = bulk[i].shape[d]
+                        bulk[i] = bulk[i].at[
+                            (slice(None),) * d + (slice(0, halo),)
+                        ].set(ring_lo[i])
+                        bulk[i] = bulk[i].at[
+                            (slice(None),) * d + (slice(n_d - halo, None),)
+                        ].set(ring_hi[i])
+            new = tuple(bulk)
+        else:
+            with jax.named_scope("stencil_update"):
+                new = update(padded)
         mask = None
         out = []
         for i, nf in enumerate(new):
