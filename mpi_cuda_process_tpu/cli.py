@@ -85,6 +85,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="local block update implementation (auto: jnp for "
                         "7-point-class stencils where XLA fuses to roofline, "
                         "pallas where the hand kernel wins)")
+    p.add_argument("--fuse", type=int, default=0,
+                   help="temporal blocking: advance K steps per HBM pass via "
+                        "the fused Pallas kernel (experimental; measured "
+                        "VPU-bound on v5e fp32 — see ops/pallas/fused.py)")
     return p
 
 
@@ -97,6 +101,7 @@ def config_from_args(argv=None) -> RunConfig:
         checkpoint_every=a.checkpoint_every, checkpoint_dir=a.checkpoint_dir,
         resume=a.resume, render=a.render, profile_dir=a.profile_dir,
         compute=a.compute, overlap=a.overlap, ensemble=a.ensemble,
+        fuse=a.fuse,
         dump_every=a.dump_every, dump_dir=a.dump_dir,
         params=parse_params(a.param),
     )
@@ -139,10 +144,28 @@ def build(cfg: RunConfig):
         fields = init_state(st, cfg.grid, cfg.seed, cfg.density, cfg.init,
                             periodic=cfg.periodic, ensemble=cfg.ensemble)
 
-    compute_fn = resolve_compute_fn(cfg, st)
     if cfg.ensemble and cfg.mesh and math.prod(cfg.mesh) > 1:
         raise ValueError("--ensemble currently excludes --mesh; "
                          "use one batching strategy at a time")
+    if cfg.fuse:
+        if cfg.ensemble or (cfg.mesh and math.prod(cfg.mesh) > 1):
+            raise ValueError("--fuse currently excludes --mesh/--ensemble")
+        if cfg.periodic:
+            raise ValueError("--fuse currently requires guard-frame BCs "
+                             "(no --periodic)")
+        if cfg.compute == "pallas" or cfg.overlap:
+            raise ValueError("--fuse replaces the whole step; it excludes "
+                             "--compute pallas and --overlap")
+        from .ops.pallas.fused import make_fused_step
+        fused = make_fused_step(st, cfg.grid, cfg.fuse)
+        if fused is None:
+            raise ValueError(
+                f"--fuse {cfg.fuse} unsupported for {st.name} on grid "
+                f"{cfg.grid} (need a fused kernel, 2k % 8 == 0, and an "
+                f"aligned tiling)")
+        # fused step_fn advances cfg.fuse steps per call; run() accounts.
+        return st, fused, fields, start_step
+    compute_fn = resolve_compute_fn(cfg, st)
     if cfg.ensemble:
         step_fn = driver.make_ensemble_step(driver.make_step(
             st, cfg.grid, periodic=cfg.periodic, compute_fn=compute_fn))
@@ -174,7 +197,7 @@ def run(cfg: RunConfig) -> Tuple:
         os.makedirs(cfg.dump_dir, exist_ok=True)
 
     def callback(done_in_run, fs):
-        step = start_step + done_in_run
+        step = start_step + done_in_run * max(1, cfg.fuse)
         if cfg.log_every and step % cfg.log_every == 0:
             d = diagnostics.field_diagnostics(st, fs)
             log.info("step %d  %s", step, diagnostics.format_diagnostics(d))
@@ -193,6 +216,24 @@ def run(cfg: RunConfig) -> Tuple:
     interval = math.gcd(*intervals) if len(intervals) > 1 else (
         intervals[0] if intervals else 0)
 
+    # With temporal blocking the step_fn advances cfg.fuse steps per call:
+    # scan over remaining/K calls, and run the callback cadence in K-units.
+    step_unit = max(1, cfg.fuse)
+    if step_unit > 1:
+        if remaining % step_unit:
+            raise ValueError(
+                f"iters remaining ({remaining}) must be a multiple of "
+                f"--fuse {step_unit}")
+        if interval % step_unit:
+            raise ValueError(
+                f"log/checkpoint/dump intervals must be multiples of "
+                f"--fuse {step_unit}")
+        if start_step % step_unit:
+            raise ValueError(
+                f"resume step {start_step} not a multiple of "
+                f"--fuse {step_unit}")
+        interval //= step_unit
+
     ctx = None
     if cfg.profile_dir:
         ctx = jax.profiler.trace(cfg.profile_dir)
@@ -200,8 +241,9 @@ def run(cfg: RunConfig) -> Tuple:
     t0 = time.perf_counter()
     try:
         fields = driver.run_simulation(
-            st, fields, remaining, step_fn=step_fn,
-            log_every=interval, callback=callback, start_step=start_step)
+            st, fields, remaining // step_unit, step_fn=step_fn,
+            log_every=interval, callback=callback,
+            start_step=start_step // step_unit)
         fields = jax.block_until_ready(fields)
     finally:
         if ctx is not None:

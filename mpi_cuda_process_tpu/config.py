@@ -35,6 +35,7 @@ class RunConfig:
     compute: str = "auto"  # auto | jnp | pallas
     overlap: bool = False  # explicit interior/boundary split for comm overlap
     ensemble: int = 0  # >0: batch of independent universes via vmap
+    fuse: int = 0  # >0: temporal blocking, k steps per HBM pass (experimental)
     dump_every: int = 0  # >0: async .npy snapshots of field0 every N steps
     dump_dir: Optional[str] = None
     params: Dict[str, Any] = dataclasses.field(default_factory=dict)

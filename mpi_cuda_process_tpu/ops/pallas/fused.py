@@ -1,0 +1,198 @@
+"""Temporal-blocking fused multi-step Pallas kernels.
+
+The reference performs one full device pass per time step (one
+``middle_kernel``+``border_kernel`` launch pair per iteration,
+kernel.cu:209/221), so its throughput ceiling is memory bandwidth: every step
+re-streams the whole grid.  The same is true of the XLA-fused jnp path here —
+~2 HBM passes (1 read + 1 write) per step, measured ~87% of that roofline on
+v5e.
+
+This module raises that ceiling the TPU way: a Pallas kernel that advances a
+tile **k time steps per HBM round-trip** (classic temporal blocking /
+overlapped tiling).  Each program reads an overlapping (bz+2k, by+2k, X)
+window of the grid into VMEM, applies k micro-steps entirely in VMEM
+(re-pinning the global guard frame between micro-steps, so the semantics are
+exactly k applications of ``driver.make_step``), and writes the (bz, by, X)
+core.  HBM traffic per step drops from 2 passes to roughly
+``((1+2k/bz)(1+2k/by) + 1)/k`` passes — 3-5x less for k=8 on 256^3-class
+grids — at the cost of ``(1+2k/bz)(1+2k/by)`` x redundant flops, which the VPU
+has headroom for on 7-point stencils.
+
+Layout choices that matter on TPU:
+  * The minor (lane) axis x is never padded or sliced: neighbor taps along x
+    come from a lane **roll**; the wrapped values land only in the global x
+    walls, which the per-micro-step frame mask re-pins anyway.  This keeps
+    every VMEM buffer at exactly X lanes (no 264->384 lane-rounding waste) and
+    avoids unaligned lane concatenation, which Mosaic cannot lower.
+  * The window is assembled from four (8,128)-aligned blocks of the z/y-padded
+    input (core, y-tail, z-tail, corner) — overlapping BlockSpecs must start
+    on block-aligned offsets, hence the ``bz % 2k == by % 2k == 0`` and
+    ``2k % 8 == 0`` tiling constraints.
+
+Operates on the RAW grid (guard frame included, no halo pre-padding), so it is
+a whole-step replacement (``fields -> fields after k steps``) rather than a
+``compute_fn``; ``driver.make_fused_runner`` scans it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..stencil import Fields, Stencil
+
+# Scoped-VMEM cost model for auto-tiling, fit to Mosaic's reported stack
+# usage: ~7 live copies of the window + ~2 of the output block, vs the
+# ~16 MiB scoped-vmem limit on v5e/v4.
+_VMEM_LIMIT = 15 * 1024 * 1024
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _roll(x, shift, axis, interpret):
+    if interpret:
+        return jnp.roll(x, shift, axis)
+    return pltpu.roll(x, shift % x.shape[axis], axis)
+
+
+def _fused_kernel_7pt(alpha, k, bz, by, shape, interpret, a, b, c, d, out):
+    """k FTCS micro-steps on a constant-shape VMEM window.
+
+    Every neighbor tap is a **roll** (no shrinking slices): sublane/lane
+    slicing at odd offsets forces a Mosaic relayout per tap per micro-step,
+    which measured ~5x slower than the XLA path; rolls keep every operand at
+    the same aligned (bz+2k, by+2k, X) layout.  Wrap-around values from the
+    rolls land only in (a) the tile's outermost shell, which temporal validity
+    excludes anyway — after m micro-steps only cells >= m away from the window
+    edge are correct, and only the inner (bz, by) core is written out — and
+    (b) the global domain walls, which the precomputed frame mask re-pins
+    every micro-step (the in-VMEM equivalent of the driver's per-step frame
+    mask; out-of-domain ghost cells of edge tiles are pinned too, bounding
+    their garbage).
+    """
+    # Reassemble the (bz+2k, by+2k, X) overlapping window from the four
+    # aligned blocks (core, y-tail, z-tail, corner).
+    top = jnp.concatenate([a[...], b[...]], axis=1)
+    bot = jnp.concatenate([c[...], d[...]], axis=1)
+    cur = jnp.concatenate([top, bot], axis=0)
+    iz = pl.program_id(0)
+    iy = pl.program_id(1)
+    # Window origin in global coordinates (input was pre-padded by k in z/y).
+    z0 = iz * bz - k
+    y0 = iy * by - k
+    Z, Y, X = shape
+    zidx = jax.lax.broadcasted_iota(jnp.int32, cur.shape, 0) + z0
+    yidx = jax.lax.broadcasted_iota(jnp.int32, cur.shape, 1) + y0
+    xidx = jax.lax.broadcasted_iota(jnp.int32, cur.shape, 2)
+    frame = (
+        (zidx <= 0) | (zidx >= Z - 1)
+        | (yidx <= 0) | (yidx >= Y - 1)
+        | (xidx == 0) | (xidx == X - 1)
+    )
+    for _ in range(k):
+        lap = (
+            _roll(cur, 1, 0, interpret)
+            + _roll(cur, -1, 0, interpret)
+            + _roll(cur, 1, 1, interpret)
+            + _roll(cur, -1, 1, interpret)
+            + _roll(cur, 1, 2, interpret)
+            + _roll(cur, -1, 2, interpret)
+            - 6.0 * cur
+        )
+        cur = jnp.where(frame, cur, cur + alpha * lap)
+    out[...] = cur[k:bz + k, k:by + k, :]
+
+
+def _lane_round(n: int) -> int:
+    return -(-n // 128) * 128
+
+
+def _pick_tiles(Z: int, Y: int, X: int, k: int, itemsize: int):
+    """Choose (bz, by) dividing (Z, Y), multiples of 2k, fitting scoped VMEM."""
+    if (2 * k) % 8:
+        return None  # y-tail blocks must be sublane-aligned
+    best = None
+    for bz in (64, 32, 16, 8):
+        for by in (64, 32, 16, 8):
+            if Z % bz or Y % by or bz % (2 * k) or by % (2 * k):
+                continue
+            window = (bz + 2 * k) * (by + 2 * k) * _lane_round(X) * itemsize
+            core = bz * by * _lane_round(X) * itemsize
+            if 7 * window + 2 * core > _VMEM_LIMIT:
+                continue
+            # prefer max core/window ratio (least redundancy), then max core
+            score = (core / window, core)
+            if best is None or score > best[0]:
+                best = (score, (bz, by))
+    return best[1] if best else None
+
+
+def fused_supported(stencil: Stencil) -> bool:
+    return stencil.name == "heat3d"
+
+
+def make_fused_step(
+    stencil: Stencil,
+    global_shape: Sequence[int],
+    k: int,
+    tiles: Optional[Tuple[int, int]] = None,
+    interpret: Optional[bool] = None,
+):
+    """Build ``fields -> fields`` advancing ``k`` steps in one kernel pass.
+
+    Semantically identical to ``k`` applications of ``driver.make_step`` for
+    the same stencil/shape (guard-frame semantics included) — asserted by
+    tests/test_fused.py.  Returns None when the shape/k cannot be tiled
+    (callers fall back to the per-step path).  ``k`` must satisfy
+    ``2k % 8 == 0`` (sublane alignment of the tail blocks), i.e. k in
+    {4, 8, 12, ...}.
+    """
+    if not fused_supported(stencil):
+        return None
+    if interpret is None:
+        interpret = _interpret_default()
+    Z, Y, X = (int(s) for s in global_shape)
+    itemsize = jnp.dtype(stencil.dtype).itemsize
+    if tiles is None:
+        tiles = _pick_tiles(Z, Y, X, k, itemsize)
+    if tiles is None:
+        return None
+    bz, by = tiles
+    alpha = float(stencil.params["alpha"])
+
+    grid = (Z // bz, Y // by)
+    # Four aligned views of the z/y-padded input reassemble each program's
+    # overlapping (bz+2k, by+2k, X) window; alignment needs bz, by % 2k == 0.
+    a = pl.BlockSpec((bz, by, X), lambda i, j: (i, j, 0))
+    b = pl.BlockSpec(
+        (bz, 2 * k, X), lambda i, j: (i, (j + 1) * by // (2 * k), 0))
+    c = pl.BlockSpec(
+        (2 * k, by, X), lambda i, j: ((i + 1) * bz // (2 * k), j, 0))
+    d = pl.BlockSpec(
+        (2 * k, 2 * k, X),
+        lambda i, j: ((i + 1) * bz // (2 * k), (j + 1) * by // (2 * k), 0))
+    out_spec = pl.BlockSpec((bz, by, X), lambda i, j: (i, j, 0))
+
+    call = pl.pallas_call(
+        functools.partial(
+            _fused_kernel_7pt, alpha, k, bz, by, (Z, Y, X), interpret),
+        grid=grid,
+        in_specs=[a, b, c, d],
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct((Z, Y, X), stencil.dtype),
+        interpret=interpret,
+    )
+
+    def step_k(fields: Fields) -> Fields:
+        (u,) = fields
+        p = jnp.pad(u, ((k, k), (k, k), (0, 0)))
+        return (call(p, p, p, p),)
+
+    return step_k

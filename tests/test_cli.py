@@ -120,6 +120,25 @@ def test_ensemble_plus_mesh_rejected():
                         ensemble=2, mesh=(2, 2)))
 
 
+def test_fuse_matches_plain_run():
+    """--fuse K (temporal blocking) must not change results."""
+    base = dict(stencil="heat3d", grid=(16, 16, 128), iters=8, init="random",
+                seed=2)
+    plain, _ = run(RunConfig(**base))
+    fused, _ = run(RunConfig(**base, fuse=4))
+    np.testing.assert_array_equal(
+        np.asarray(fused[0]), np.asarray(plain[0]))
+
+
+def test_fuse_rejects_bad_configs():
+    import pytest
+    with pytest.raises(ValueError, match="fuse"):
+        build(RunConfig(stencil="heat3d", grid=(16, 16, 128), iters=8,
+                        fuse=4, mesh=(2, 1, 1)))
+    with pytest.raises(ValueError, match="fuse"):
+        build(RunConfig(stencil="life", grid=(16, 16), iters=8, fuse=4))
+
+
 def test_dump_every_writes_snapshots(tmp_path):
     d = str(tmp_path / "dumps")
     run(RunConfig(stencil="heat2d", grid=(16, 16), iters=10,

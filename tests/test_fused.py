@@ -1,0 +1,77 @@
+"""Fused temporal-blocking kernel == k applications of the plain step.
+
+The fused kernel (ops/pallas/fused.py) advances k time steps per HBM pass;
+its contract is bit-identical guard-frame semantics to ``driver.make_step``
+applied k times.  Runs in Pallas interpret mode on CPU (SURVEY.md §4.4).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mpi_cuda_process_tpu import init_state, make_step, make_stencil
+from mpi_cuda_process_tpu.driver import make_runner
+from mpi_cuda_process_tpu.ops.pallas.fused import (
+    _pick_tiles,
+    make_fused_step,
+)
+
+
+@pytest.mark.parametrize(
+    "shape,k",
+    [
+        ((16, 16, 128), 4),
+        ((32, 16, 128), 4),
+        ((16, 32, 256), 8),
+    ],
+)
+def test_fused_matches_plain_steps(shape, k):
+    st = make_stencil("heat3d")
+    fields = init_state(st, shape, seed=3, kind="random")
+    step = jax.jit(make_step(st, shape))
+    ref = fields
+    for _ in range(k):
+        ref = step(ref)
+    fused = make_fused_step(st, shape, k, interpret=True)
+    assert fused is not None
+    out = jax.jit(fused)(fields)
+    # Identical op order per cell => bit-exact, not just close.
+    assert jnp.array_equal(out[0], ref[0])
+
+
+def test_fused_in_scan_runner(_k=4, _n=3):
+    st = make_stencil("heat3d")
+    shape = (16, 16, 128)
+    fields = init_state(st, shape, seed=0, kind="pulse")
+    fused = make_fused_step(st, shape, _k, interpret=True)
+    out = make_runner(fused, _n)(fields)
+    ref = make_runner(make_step(st, shape), _k * _n)(
+        init_state(st, shape, seed=0, kind="pulse"))
+    assert jnp.allclose(out[0], ref[0], atol=1e-5)
+
+
+def test_fused_frame_stays_pinned():
+    st = make_stencil("heat3d")
+    shape = (16, 16, 128)
+    fields = init_state(st, shape, seed=1, kind="random")
+    fused = make_fused_step(st, shape, 4, interpret=True)
+    out = jax.jit(fused)(fields)[0]
+    u0 = fields[0]
+    for d in range(3):
+        lo = [slice(None)] * 3
+        hi = [slice(None)] * 3
+        lo[d] = 0
+        hi[d] = -1
+        assert jnp.array_equal(out[tuple(lo)], u0[tuple(lo)])
+        assert jnp.array_equal(out[tuple(hi)], u0[tuple(hi)])
+
+
+def test_unsupported_configs_return_none():
+    st = make_stencil("heat3d")
+    # k with 2k % 8 != 0 (sublane alignment) is rejected
+    assert make_fused_step(st, (16, 16, 128), 2, interpret=True) is None
+    # shapes not tileable into aligned blocks are rejected
+    assert _pick_tiles(10, 16, 128, 4, 4) is None
+    # only the flagship 7-point model has a fused kernel so far
+    assert make_fused_step(
+        make_stencil("life"), (32, 32), 4, interpret=True) is None
