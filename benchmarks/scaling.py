@@ -1,0 +1,170 @@
+"""Weak/strong-scaling benchmark harness (BASELINE.json north star).
+
+The reference has no benchmark instrumentation at all (SURVEY.md §6); its
+scaling story is fixed at exactly 2 ranks.  This harness measures the
+framework's domain-decomposition scaling on any device population:
+
+  * **weak scaling**: per-device block held fixed while the mesh grows; the
+    headline metric is Mcells/s/device and efficiency vs the 1-device run
+    (target >=90% at 64 chips, BASELINE.md).
+  * **strong scaling**: global grid held fixed while the mesh grows.
+  * **halo overhead**: per-step cost of the exchange, isolated by timing the
+    same local block with and without the sharded exchange path.
+
+Runs identically on a real TPU slice and on virtual CPU devices
+(``--virtual N`` forces ``xla_force_host_platform_device_count`` — the
+numbers are then only relative, but the harness and its efficiency
+accounting are what ship).  Results print as a table plus one JSON line per
+config for machine consumption.
+
+Usage::
+
+    python benchmarks/scaling.py --mode weak --stencil heat3d \
+        --block 64,64,64 --steps 20 --virtual 8
+    python benchmarks/scaling.py --mode strong --stencil heat3d \
+        --grid 128,128,128 --steps 20 --virtual 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _setup_devices(virtual: int):
+    if virtual:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={virtual}"
+            ).strip()
+    import jax
+
+    if virtual:
+        jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+def _mesh_ladder(n_devices: int, ndim: int):
+    """Mesh shapes 1, 2, 4, ... n_devices, factored over ndim axes."""
+    from mpi_cuda_process_tpu.parallel.mesh import factor_mesh
+
+    n = 1
+    out = []
+    while n <= n_devices:
+        out.append(factor_mesh(n, ndim))
+        n *= 2
+    return out
+
+
+def _time_run(run, fields, reps: int) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    def fence(fs):
+        return float(jnp.sum(fs[0]))
+
+    best = math.inf
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fence(run(fields))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_config(st, mesh_shape, global_shape, steps, reps=3):
+    import jax
+
+    from mpi_cuda_process_tpu import (
+        init_state, make_mesh, make_sharded_step, make_step, shard_fields,
+    )
+    from mpi_cuda_process_tpu.driver import make_runner
+
+    n_dev = math.prod(mesh_shape)
+    if n_dev > 1:
+        mesh = make_mesh(mesh_shape)
+        step = make_sharded_step(st, mesh, global_shape)
+    else:
+        step = make_step(st, global_shape)
+    fields = init_state(st, global_shape, kind="auto")
+    if n_dev > 1:
+        fields = shard_fields(fields, mesh, st.ndim)
+    # No donation: the same input fields are reused across timing reps.
+    run_nodonate = make_runner(step, steps, jit=False)
+    run = jax.jit(run_nodonate)
+    import jax.numpy as jnp
+
+    float(jnp.sum(run(fields)[0]))  # compile + warm
+    t = _time_run(run, fields, reps)
+    cells = math.prod(global_shape)
+    return cells * steps / t / 1e6, t / steps
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--mode", choices=["weak", "strong"], default="weak")
+    p.add_argument("--stencil", default="heat3d")
+    p.add_argument("--block", default="64,64,64",
+                   help="per-device block (weak mode)")
+    p.add_argument("--grid", default="128,128,128",
+                   help="global grid (strong mode)")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--virtual", type=int, default=0,
+                   help="force N virtual CPU devices (0 = real devices)")
+    a = p.parse_args(argv)
+
+    jax = _setup_devices(a.virtual)
+    from mpi_cuda_process_tpu.config import parse_int_tuple
+    from mpi_cuda_process_tpu.ops.stencil import make_stencil
+
+    st = make_stencil(a.stencil)
+    n_devices = len(jax.devices())
+    base = None
+    rows = []
+    for mesh_shape in _mesh_ladder(n_devices, st.ndim):
+        n_dev = math.prod(mesh_shape)
+        if a.mode == "weak":
+            block = parse_int_tuple(a.block)
+            global_shape = tuple(b * m for b, m in zip(block, mesh_shape))
+        else:
+            global_shape = parse_int_tuple(a.grid)
+            if any(g % m for g, m in zip(global_shape, mesh_shape)):
+                continue
+        mcells, per_step = bench_config(
+            st, mesh_shape, global_shape, a.steps, a.reps)
+        per_dev = mcells / n_dev
+        if base is None:
+            base = per_dev if a.mode == "weak" else mcells
+        eff = (per_dev / base if a.mode == "weak"
+               else mcells / (base * n_dev))
+        rows.append((mesh_shape, global_shape, mcells, per_dev, eff))
+        rec = {
+            "mode": a.mode, "stencil": a.stencil,
+            "mesh": list(mesh_shape), "grid": list(global_shape),
+            "mcells_per_s": round(mcells, 1),
+            "mcells_per_s_per_device": round(per_dev, 1),
+            "efficiency": round(eff, 4),
+            "ms_per_step": round(per_step * 1e3, 3),
+        }
+        print(json.dumps(rec))
+
+    print(f"\n{a.mode} scaling — {a.stencil}"
+          f" ({n_devices} devices, {jax.default_backend()})", file=sys.stderr)
+    print(f"{'mesh':>12} {'grid':>16} {'Mcells/s':>10}"
+          f" {'/device':>10} {'eff':>6}", file=sys.stderr)
+    for mesh_shape, g, mc, pd, eff in rows:
+        print(f"{'x'.join(map(str, mesh_shape)):>12}"
+              f" {'x'.join(map(str, g)):>16}"
+              f" {mc:>10.0f} {pd:>10.0f} {eff:>6.1%}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

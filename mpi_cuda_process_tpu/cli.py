@@ -28,7 +28,7 @@ import numpy as np
 from . import driver
 from .config import RunConfig, parse_int_tuple, parse_params
 from .ops import stencil as stencil_lib
-from .ops import heat, life, wave  # noqa: F401  (populate the registry)
+from .ops import advection, heat, life, reaction, wave  # noqa: F401  (populate the registry)
 from .parallel import mesh as mesh_lib
 from .parallel import stepper as stepper_lib
 import os
@@ -57,7 +57,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--density", type=float, default=0.15,
                    help="alive probability for random init (reference: 0.15)")
     p.add_argument("--init", default="auto",
-                   choices=["auto", "random", "zero", "pulse"])
+                   choices=["auto", "random", "zero", "pulse", "patch"])
     p.add_argument("--periodic", action="store_true",
                    help="periodic BCs instead of guard-cell frame")
     p.add_argument("--param", action="append", default=[],
@@ -85,6 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="local block update implementation (auto: jnp for "
                         "7-point-class stencils where XLA fuses to roofline, "
                         "pallas where the hand kernel wins)")
+    p.add_argument("--tol", type=float, default=0.0,
+                   help="stop when the residual max|u - u_prev_check| over a "
+                        "--tol-check-every-step interval drops below TOL "
+                        "(solver-style convergence; --iters is the step cap)")
+    p.add_argument("--tol-check-every", type=int, default=10,
+                   help="steps between residual checks for --tol")
     p.add_argument("--fuse", type=int, default=0,
                    help="temporal blocking: advance K steps per HBM pass via "
                         "the fused Pallas kernel (experimental; measured "
@@ -101,7 +107,7 @@ def config_from_args(argv=None) -> RunConfig:
         checkpoint_every=a.checkpoint_every, checkpoint_dir=a.checkpoint_dir,
         resume=a.resume, render=a.render, profile_dir=a.profile_dir,
         compute=a.compute, overlap=a.overlap, ensemble=a.ensemble,
-        fuse=a.fuse,
+        fuse=a.fuse, tol=a.tol, tol_check_every=a.tol_check_every,
         dump_every=a.dump_every, dump_dir=a.dump_dir,
         params=parse_params(a.param),
     )
@@ -182,6 +188,24 @@ def build(cfg: RunConfig):
     return st, step_fn, fields, start_step
 
 
+def _profiled(cfg: RunConfig):
+    """jax.profiler trace context for --profile-dir (no-op context otherwise)."""
+    import contextlib
+
+    if cfg.profile_dir:
+        return jax.profiler.trace(cfg.profile_dir)
+    return contextlib.nullcontext()
+
+
+def _epilogue(cfg: RunConfig, fields, final_step: int, save_ckpt: bool):
+    """Shared run tail: final checkpoint + optional ASCII render."""
+    if save_ckpt and cfg.checkpoint_dir:
+        checkpointing.save_checkpoint(
+            cfg.checkpoint_dir, fields, final_step, dataclasses.asdict(cfg))
+    if cfg.render:
+        print(render.ascii_render(np.asarray(fields[0])))
+
+
 def run(cfg: RunConfig) -> Tuple:
     """Execute a configured run; returns (final_fields, mcells_per_s)."""
     mesh_lib.bootstrap_distributed()
@@ -192,6 +216,24 @@ def run(cfg: RunConfig) -> Tuple:
         return fields, 0.0
 
     cells = math.prod(cfg.grid) * max(1, cfg.ensemble)
+
+    if cfg.tol > 0:
+        if cfg.fuse or cfg.log_every or cfg.checkpoint_every or cfg.dump_every:
+            raise ValueError("--tol runs inside one while_loop; it excludes "
+                             "--fuse and periodic log/checkpoint/dump")
+        t0 = time.perf_counter()
+        with _profiled(cfg):
+            fields, n_done, res = driver.run_until(
+                step_fn, fields, cfg.tol, remaining,
+                check_every=cfg.tol_check_every)
+        dt = time.perf_counter() - t0
+        mcells = cells * n_done / dt / 1e6 if n_done else 0.0
+        log.info(
+            "converged=%s after %d steps (residual %.3e, tol %.1e) in %.3fs"
+            "  (%.1f Mcells/s)",
+            res <= cfg.tol, n_done, res, cfg.tol, dt, mcells)
+        _epilogue(cfg, fields, start_step + n_done, save_ckpt=True)
+        return fields, mcells
 
     if cfg.dump_every and cfg.dump_dir:
         os.makedirs(cfg.dump_dir, exist_ok=True)
@@ -234,32 +276,21 @@ def run(cfg: RunConfig) -> Tuple:
                 f"--fuse {step_unit}")
         interval //= step_unit
 
-    ctx = None
-    if cfg.profile_dir:
-        ctx = jax.profiler.trace(cfg.profile_dir)
-        ctx.__enter__()
     t0 = time.perf_counter()
-    try:
+    with _profiled(cfg):
         fields = driver.run_simulation(
             st, fields, remaining // step_unit, step_fn=step_fn,
             log_every=interval, callback=callback,
             start_step=start_step // step_unit)
         fields = jax.block_until_ready(fields)
-    finally:
-        if ctx is not None:
-            ctx.__exit__(None, None, None)
     dt = time.perf_counter() - t0
     if cfg.dump_every and cfg.dump_dir:
         native.wait_all()  # drain the async dump queue; surfaces IO errors
     mcells = cells * remaining / dt / 1e6
 
-    if cfg.checkpoint_dir and cfg.checkpoint_every:
-        checkpointing.save_checkpoint(
-            cfg.checkpoint_dir, fields, cfg.iters, dataclasses.asdict(cfg))
     log.info("%d steps on %s grid in %.3fs  (%.1f Mcells/s)",
              remaining, "x".join(map(str, cfg.grid)), dt, mcells)
-    if cfg.render:
-        print(render.ascii_render(np.asarray(fields[0])))
+    _epilogue(cfg, fields, cfg.iters, save_ckpt=bool(cfg.checkpoint_every))
     return fields, mcells
 
 

@@ -1,0 +1,72 @@
+"""First-order upwind advection (constant velocity field).
+
+Not present in the reference (its only physics are Life and diffusion —
+kernel.cu:10-68, MDF_kernel.cu:10-22); added as the transport member of the
+stencil family because it exercises an *asymmetric* footprint: upwinding
+reads only the upstream neighbor per axis, so the update is direction-
+dependent in a way the symmetric Laplacian ops never are — a good probe that
+the halo machinery makes no symmetry assumptions.
+
+Update (per axis d, with signed Courant number c_d = v_d * dt / dx_d):
+
+    u' = u - sum_d [ max(c_d, 0) * (u - u_{d-1}) + min(c_d, 0) * (u_{d+1} - u) ]
+
+Stable for sum_d |c_d| <= 1.  Guard frame = inflow Dirichlet value.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .stencil import Stencil, interior, register, shifted
+
+
+def _make_upwind_update(ndim, courant):
+    def update(padded):
+        (p,) = padded
+        u = interior(p, 1, ndim)
+        acc = u
+        for d, c in enumerate(courant):
+            if c == 0.0:
+                continue
+            off_m = [0] * ndim
+            off_m[d] = -1
+            off_p = [0] * ndim
+            off_p[d] = 1
+            if c > 0:
+                acc = acc - c * (u - shifted(p, tuple(off_m), 1))
+            else:
+                acc = acc - c * (shifted(p, tuple(off_p), 1) - u)
+        return (acc,)
+
+    return update
+
+
+def _make_advection(name, ndim, courant, bc, dtype):
+    courant = tuple(float(c) for c in courant)
+    if len(courant) != ndim:
+        raise ValueError(f"{name}: need {ndim} courant numbers, got {courant}")
+    if sum(abs(c) for c in courant) > 1.0:
+        raise ValueError(f"{name}: unstable courant {courant} (sum |c| > 1)")
+    return Stencil(
+        name=name,
+        ndim=ndim,
+        halo=1,
+        num_fields=1,
+        dtype=jnp.dtype(dtype),
+        bc_value=(bc,),
+        update=_make_upwind_update(ndim, courant),
+        params={"courant": courant, "bc": bc},
+    )
+
+
+@register("advect2d")
+def advect2d(cx=0.4, cy=0.4, bc=0.0, dtype=jnp.float32) -> Stencil:
+    # grid axes are (y, x)
+    return _make_advection("advect2d", 2, (cy, cx), bc, dtype)
+
+
+@register("advect3d")
+def advect3d(cx=0.3, cy=0.3, cz=0.3, bc=0.0, dtype=jnp.float32) -> Stencil:
+    # grid axes are (z, y, x)
+    return _make_advection("advect3d", 3, (cz, cy, cx), bc, dtype)

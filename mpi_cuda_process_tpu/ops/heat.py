@@ -43,6 +43,14 @@ def heat2d(alpha=0.25, bc=100.0, dtype=jnp.float32) -> Stencil:
     )
 
 
+@register("mdf")
+def mdf(alpha=0.25, bc=100.0, dtype=jnp.float32) -> Stencil:
+    """Reference-name alias: *Método das Diferenças Finitas* — the exact
+    workload of MDF_kernel.cu (5-point FTCS at the 2D stability limit
+    alpha=0.25, hot 100.0 Dirichlet walls)."""
+    return heat2d(alpha=alpha, bc=bc, dtype=dtype)
+
+
 @register("heat3d")
 def heat3d(alpha=1.0 / 6.0, bc=100.0, dtype=jnp.float32) -> Stencil:
     """3D 7-point FTCS heat diffusion (BASELINE.json configs 2-3)."""

@@ -1,0 +1,63 @@
+"""Gray-Scott reaction-diffusion: two coupled diffusing fields.
+
+Not present in the reference; added as the multi-field member where BOTH
+fields carry stencil footprints — the wave model's second field is
+neighbor-free (``field_halos=(1, 0)``), so Gray-Scott is the case that
+exercises simultaneous halo exchange of every field in the state.
+
+    u' = u + Du * Lap(u) - u v^2 + F (1 - u)
+    v' = v + Dv * Lap(v) + u v^2 - (F + kappa) v
+
+The classic pattern-forming system (spots/stripes for F ~ 0.03-0.06).
+Guard frame pins u = 1, v = 0 (the trivial steady state), the reaction
+analogue of the reference's Dirichlet walls (MDF_kernel.cu:92-93).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .stencil import Stencil, axis_laplacian, register
+
+
+def _make_gray_scott_update(ndim, du, dv, f, kappa):
+    def update(padded):
+        pu, pv = padded
+        u, lap_u = axis_laplacian(pu, ndim)
+        v, lap_v = axis_laplacian(pv, ndim)
+        uvv = u * v * v
+        new_u = u + du * lap_u - uvv + f * (1.0 - u)
+        new_v = v + dv * lap_v + uvv - (f + kappa) * v
+        return (new_u, new_v)
+
+    return update
+
+
+@register("grayscott2d")
+def grayscott2d(du=0.16, dv=0.08, f=0.035, kappa=0.06,
+                dtype=jnp.float32) -> Stencil:
+    return Stencil(
+        name="grayscott2d",
+        ndim=2,
+        halo=1,
+        num_fields=2,
+        dtype=jnp.dtype(dtype),
+        bc_value=(1.0, 0.0),
+        update=_make_gray_scott_update(2, du, dv, f, kappa),
+        params={"du": du, "dv": dv, "f": f, "kappa": kappa},
+    )
+
+
+@register("grayscott3d")
+def grayscott3d(du=0.1, dv=0.05, f=0.035, kappa=0.06,
+                dtype=jnp.float32) -> Stencil:
+    return Stencil(
+        name="grayscott3d",
+        ndim=3,
+        halo=1,
+        num_fields=2,
+        dtype=jnp.dtype(dtype),
+        bc_value=(1.0, 0.0),
+        update=_make_gray_scott_update(3, du, dv, f, kappa),
+        params={"du": du, "dv": dv, "f": f, "kappa": kappa},
+    )

@@ -20,6 +20,17 @@ from ..driver import frame_mask
 from ..ops.stencil import Fields, Stencil
 
 
+def _gaussian_bump(grid_shape, sigma: float = 0.05) -> jax.Array:
+    """Centered Gaussian bump in [0, 1], normalized coordinates per axis."""
+    r2 = 0.0
+    for d, n in enumerate(grid_shape):
+        c = (jnp.arange(n, dtype=jnp.float32) - (n - 1) / 2.0) / max(n, 2)
+        shape = [1] * len(grid_shape)
+        shape[d] = n
+        r2 = r2 + c.reshape(shape) ** 2
+    return jnp.exp(-r2 / (2 * sigma**2))
+
+
 def _pin_frame(x: jax.Array, value, width: int) -> jax.Array:
     mask = frame_mask(x.shape, x.shape, (0,) * x.ndim, width)
     return jnp.where(mask, jnp.asarray(value, x.dtype), x)
@@ -39,7 +50,8 @@ def init_state(
     kinds:
       - ``"random"``: Bernoulli(density) occupancy (Life's create_universe).
       - ``"zero"``: zero interior with guard-frame walls (MDF's intended init).
-      - ``"pulse"``: centered Gaussian bump (wave models).
+      - ``"pulse"``: centered Gaussian bump (wave/advection models).
+      - ``"patch"``: u~1 background + perturbed central patch (Gray-Scott).
       - ``"auto"``: pick by stencil family.
 
     ``ensemble > 0`` returns fields with a leading batch axis of that many
@@ -64,6 +76,10 @@ def init_state(
     if kind == "auto":
         if stencil.name == "life":
             kind = "random"
+        elif stencil.name.startswith("grayscott"):
+            kind = "patch"
+        elif stencil.name.startswith("advect"):
+            kind = "pulse"
         elif stencil.num_fields == 2:
             kind = "pulse"
         else:
@@ -81,17 +97,21 @@ def init_state(
         fields = tuple(
             jnp.zeros(grid_shape, dtype) for _ in range(stencil.num_fields)
         )
+    elif kind == "patch":
+        # Reaction-diffusion seed: u ~ 1 background with a perturbed central
+        # patch, v nonzero only inside the patch (Gray-Scott convention).
+        key = jax.random.PRNGKey(seed)
+        centre = _gaussian_bump(grid_shape)
+        patch = (centre > 0.5).astype(jnp.float32)
+        noise = 0.02 * jax.random.uniform(key, grid_shape)
+        u = (1.0 - 0.5 * patch + noise).astype(dtype)
+        v = (0.25 * patch).astype(dtype)
+        fields = (u, v) + tuple(
+            jnp.zeros(grid_shape, dtype)
+            for _ in range(stencil.num_fields - 2)
+        )
     elif kind == "pulse":
-        coords = [
-            (jnp.arange(n, dtype=jnp.float32) - (n - 1) / 2.0) / max(n, 2)
-            for n in grid_shape
-        ]
-        r2 = 0.0
-        for d, c in enumerate(coords):
-            shape = [1] * len(grid_shape)
-            shape[d] = grid_shape[d]
-            r2 = r2 + (c.reshape(shape)) ** 2
-        u = jnp.exp(-r2 / (2 * 0.05**2)).astype(dtype)
+        u = _gaussian_bump(grid_shape).astype(dtype)
         # zero initial velocity: u_prev = u
         fields = (u,) + tuple(u for _ in range(stencil.num_fields - 1))
     else:

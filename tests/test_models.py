@@ -1,0 +1,205 @@
+"""Golden/property tests for the extended model zoo (advection, Gray-Scott,
+mdf alias) and the convergence runner (SURVEY.md §4.1, §4.5)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mpi_cuda_process_tpu import (
+    init_state,
+    make_mesh,
+    make_sharded_step,
+    make_step,
+    make_stencil,
+    shard_fields,
+)
+from mpi_cuda_process_tpu.driver import make_runner, run_until
+
+
+# ---------------------------------------------------------------------------
+# mdf alias
+# ---------------------------------------------------------------------------
+
+
+def test_mdf_alias_is_reference_heat2d():
+    st = make_stencil("mdf")
+    assert st.name == "heat2d"
+    assert st.params["alpha"] == 0.25  # MDF_kernel.cu:20 coefficient
+    assert st.bc_value == (100.0,)  # MDF_kernel.cu:92-93 hot walls
+
+
+# ---------------------------------------------------------------------------
+# advection
+# ---------------------------------------------------------------------------
+
+
+def _np_upwind_2d(u, cy, cx, bc):
+    """Independent numpy upwind step (guard-frame semantics)."""
+    p = np.pad(u, 1, constant_values=bc)
+    c = p[1:-1, 1:-1]
+    out = c.copy()
+    if cy > 0:
+        out = out - cy * (c - p[:-2, 1:-1])
+    elif cy < 0:
+        out = out - cy * (p[2:, 1:-1] - c)
+    if cx > 0:
+        out = out - cx * (c - p[1:-1, :-2])
+    elif cx < 0:
+        out = out - cx * (p[1:-1, 2:] - c)
+    res = u.copy()
+    res[1:-1, 1:-1] = out[1:-1, 1:-1]
+    return res
+
+
+@pytest.mark.parametrize("cx,cy", [(0.4, 0.3), (-0.4, 0.2), (0.0, -0.5)])
+def test_advect2d_matches_numpy_golden(cx, cy):
+    st = make_stencil("advect2d", cx=cx, cy=cy)
+    rng = np.random.RandomState(0)
+    u0 = rng.rand(12, 14).astype(np.float32)
+    u0[0, :] = u0[-1, :] = u0[:, 0] = u0[:, -1] = 0.0
+    step = jax.jit(make_step(st, u0.shape))
+    got = np.asarray(step((jnp.asarray(u0),))[0])
+    want = _np_upwind_2d(u0, cy, cx, 0.0)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_advect2d_transports_pulse_downstream():
+    st = make_stencil("advect2d", cx=0.5, cy=0.0)
+    shape = (17, 33)
+    fields = init_state(st, shape, kind="pulse")
+    out = make_runner(make_step(st, shape), 20)(fields)
+    # center of mass moved in +x by ~ cx * steps
+    u0 = np.asarray(init_state(st, shape, kind="pulse")[0])
+    u1 = np.asarray(out[0])
+    xs = np.arange(shape[1])
+    com0 = (u0.sum(0) * xs).sum() / u0.sum()
+    com1 = (u1.sum(0) * xs).sum() / u1.sum()
+    assert 7 < com1 - com0 <= 10.5  # 0.5 * 20 = 10 cells, minus wall losses
+
+
+def test_advect3d_stability_guard():
+    with pytest.raises(ValueError, match="unstable"):
+        make_stencil("advect3d", cx=0.5, cy=0.5, cz=0.5)
+
+
+def test_advect_sharded_matches_unsharded():
+    st = make_stencil("advect2d", cx=0.4, cy=-0.2)
+    shape = (16, 16)
+    fields = init_state(st, shape, seed=2, kind="pulse")
+    ref = make_runner(make_step(st, shape), 5)(fields)
+    mesh = make_mesh((2, 2))
+    sf = shard_fields(init_state(st, shape, seed=2, kind="pulse"),
+                      mesh, st.ndim)
+    out = make_runner(make_sharded_step(st, mesh, shape), 5)(sf)
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.asarray(ref[0]), atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Gray-Scott
+# ---------------------------------------------------------------------------
+
+
+def test_grayscott_trivial_steady_state():
+    """u=1, v=0 is an exact fixed point of the reaction-diffusion system."""
+    st = make_stencil("grayscott2d")
+    u = jnp.ones((12, 12), st.dtype)
+    v = jnp.zeros((12, 12), st.dtype)
+    out = jax.jit(make_step(st, (12, 12)))((u, v))
+    np.testing.assert_allclose(np.asarray(out[0]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out[1]), 0.0, atol=1e-6)
+
+
+def test_grayscott_patch_activates_and_stays_bounded():
+    st = make_stencil("grayscott2d")
+    shape = (48, 48)
+    fields = init_state(st, shape, seed=1)
+    out = make_runner(make_step(st, shape), 200)(fields)
+    u, v = (np.asarray(f) for f in out)
+    assert np.isfinite(u).all() and np.isfinite(v).all()
+    assert v.max() > 1e-3  # reaction is alive
+    assert 0.0 <= u.min() and u.max() <= 1.5 and v.max() <= 1.0
+
+
+def test_grayscott_sharded_both_fields_exchanged():
+    st = make_stencil("grayscott2d")
+    assert st.field_halos == (1, 1)
+    shape = (24, 24)
+    ref = make_runner(make_step(st, shape), 8)(
+        init_state(st, shape, seed=4))
+    mesh = make_mesh((2, 2))
+    sf = shard_fields(init_state(st, shape, seed=4), mesh, st.ndim)
+    out = make_runner(make_sharded_step(st, mesh, shape), 8)(sf)
+    for a, b in zip(out, ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# convergence runner
+# ---------------------------------------------------------------------------
+
+
+def test_run_until_converges_to_hot_walls():
+    """MDF physics: interior relaxes toward the 100.0 Dirichlet walls."""
+    st = make_stencil("heat2d")
+    shape = (12, 12)
+    fields = init_state(st, shape, kind="zero")
+    step = make_step(st, shape)
+    out, n, res = run_until(step, fields, tol=1e-3, max_steps=10_000,
+                            check_every=25)
+    assert res <= 1e-3 and n < 10_000
+    u = np.asarray(out[0])
+    assert u.min() > 95.0  # near-uniform hot steady state
+
+
+def test_run_until_respects_max_steps():
+    st = make_stencil("heat2d")
+    shape = (12, 12)
+    fields = init_state(st, shape, kind="zero")
+    step = make_step(st, shape)
+    # check_every does not divide max_steps: the cap must still be exact
+    out, n, res = run_until(step, fields, tol=0.0, max_steps=30,
+                            check_every=7)
+    assert n == 30 and res > 0.0
+
+
+def test_run_until_matches_fixed_steps():
+    """run_until with an unreachable tol == plain scan of max_steps."""
+    st = make_stencil("heat2d")
+    shape = (10, 10)
+    mk = lambda: init_state(st, shape, kind="zero")  # noqa: E731
+    step = make_step(st, shape)
+    out, n, _ = run_until(step, mk(), tol=0.0, max_steps=20, check_every=5)
+    ref = make_runner(step, 20)(mk())
+    assert n == 20
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.asarray(ref[0]), atol=1e-6)
+
+
+def test_run_until_sharded():
+    st = make_stencil("heat2d")
+    shape = (16, 16)
+    mesh = make_mesh((2, 2))
+    fields = shard_fields(init_state(st, shape, kind="zero"), mesh, st.ndim)
+    step = make_sharded_step(st, mesh, shape)
+    out, n, res = run_until(step, fields, tol=1e-3, max_steps=10_000,
+                            check_every=50)
+    assert res <= 1e-3
+    assert np.asarray(out[0]).min() > 95.0
+
+
+def test_cli_tol_path():
+    from mpi_cuda_process_tpu.cli import run
+    from mpi_cuda_process_tpu.config import RunConfig
+
+    fields, _ = run(RunConfig(stencil="heat2d", grid=(12, 12), iters=10_000,
+                              init="zero", tol=1e-3, tol_check_every=25))
+    assert np.asarray(fields[0]).min() > 95.0
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="tol"):
+        run(RunConfig(stencil="heat2d", grid=(12, 12), iters=100,
+                      tol=1e-3, log_every=10))
