@@ -106,9 +106,58 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3):
     return cells * steps / t / 1e6, t / steps
 
 
+def bench_halo_overhead(st, mesh_shape, global_shape, steps, reps=3):
+    """Per-step halo-exchange cost, isolated (SURVEY.md §5.1 attribution).
+
+    Times the sharded step (exchange + update) against an exchange-free
+    variant of the same local block update (the BCs-only padding path), on
+    the same mesh.  The difference per step is the exchange + boundary-splice
+    cost the decomposition adds.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_cuda_process_tpu import (
+        init_state, make_mesh, make_sharded_step, shard_fields,
+    )
+    from mpi_cuda_process_tpu.driver import make_runner
+    from mpi_cuda_process_tpu.parallel.stepper import grid_partition_spec
+
+    from mpi_cuda_process_tpu.parallel.stepper import shard_map
+
+    mesh = make_mesh(mesh_shape)
+    step = make_sharded_step(st, mesh, global_shape)
+
+    # exchange-free control: same local compute, halo from BC constants only
+    from mpi_cuda_process_tpu.parallel.halo import exchange_and_pad
+
+    ndim = st.ndim
+
+    def local_only(fields):
+        padded = tuple(
+            exchange_and_pad(f, (None,) * ndim, (1,) * ndim, fh, bc)
+            for f, bc, fh in zip(fields, st.bc_value, st.field_halos))
+        return st.update(padded)
+
+    spec = grid_partition_spec(ndim, mesh)
+    nostep = shard_map(local_only, mesh=mesh, in_specs=(spec,),
+                       out_specs=spec, check_vma=False)
+
+    fields = shard_fields(
+        init_state(st, global_shape, kind="auto"), mesh, ndim)
+    r_full = jax.jit(make_runner(step, steps, jit=False))
+    r_local = jax.jit(make_runner(nostep, steps, jit=False))
+    for r in (r_full, r_local):
+        float(jnp.sum(r(fields)[0]))
+    t_full = _time_run(r_full, fields, reps) / steps
+    t_local = _time_run(r_local, fields, reps) / steps
+    return t_full, t_local
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("--mode", choices=["weak", "strong"], default="weak")
+    p.add_argument("--mode", choices=["weak", "strong", "halo"],
+                   default="weak")
     p.add_argument("--stencil", default="heat3d")
     p.add_argument("--block", default="64,64,64",
                    help="per-device block (weak mode)")
@@ -126,6 +175,27 @@ def main(argv=None) -> int:
 
     st = make_stencil(a.stencil)
     n_devices = len(jax.devices())
+
+    if a.mode == "halo":
+        ladder = _mesh_ladder(n_devices, st.ndim)[1:]
+        if not ladder:
+            p.error("halo mode needs >= 2 devices (try --virtual 8)")
+        for mesh_shape in ladder:
+            block = parse_int_tuple(a.block)
+            global_shape = tuple(b * m for b, m in zip(block, mesh_shape))
+            t_full, t_local = bench_halo_overhead(
+                st, mesh_shape, global_shape, a.steps, a.reps)
+            overhead = max(t_full - t_local, 0.0)
+            print(json.dumps({
+                "mode": "halo", "stencil": a.stencil,
+                "mesh": list(mesh_shape), "grid": list(global_shape),
+                "ms_per_step_full": round(t_full * 1e3, 3),
+                "ms_per_step_no_exchange": round(t_local * 1e3, 3),
+                "halo_overhead_ms": round(overhead * 1e3, 3),
+                "halo_overhead_frac": round(overhead / t_full, 4),
+            }))
+        return 0
+
     base = None
     rows = []
     for mesh_shape in _mesh_ladder(n_devices, st.ndim):

@@ -84,8 +84,23 @@ def _slab_taps_27(alpha, s, bz):
     return u + alpha * acc
 
 
+def _slab_taps_13(alpha, s, bz):
+    # 4th-order 13-point Laplacian on a halo-2 slab: s is (bz+4, yp, xp).
+    w = {1: 16.0 / 12.0, 2: -1.0 / 12.0}
+    u = s[2:bz + 2, 2:-2, 2:-2]
+    acc = (-30.0 / 12.0 * 3.0) * u
+    for dist in (1, 2):
+        for o in (-dist, dist):
+            acc = acc + w[dist] * (
+                s[2 + o:2 + o + bz, 2:-2, 2:-2]
+                + s[2:bz + 2, 2 + o:(o - 2) or None, 2:-2]
+                + s[2:bz + 2, 2:-2, 2 + o:(o - 2) or None]
+            )
+    return u + alpha * acc
+
+
 def _zchunk_kernel(taps, bz, zc, ztail, out):
-    s = jnp.concatenate([zc[...], ztail[...]], axis=0)  # bz + 2 planes
+    s = jnp.concatenate([zc[...], ztail[...]], axis=0)  # bz + 2*halo planes
     out[...] = taps(s, bz)
 
 
@@ -105,42 +120,52 @@ def _zchunk_wave_kernel(c2dt2, bz, zc, ztail, prev, out_u):
     # new u_prev is carried verbatim by the stepper (carry_map), not written
 
 
-def _pick_bz(z: int, plane_bytes: int, extra_planes: int = 0) -> int:
+def _pick_bz(z: int, plane_bytes: int, extra_planes: int = 0,
+             halo: int = 1) -> int:
     # VMEM ~16MB; the pipeline double-buffers each spec:
-    # 2*(bz planes + 2 planes + out block (+ extras like wave's prev)).
+    # 2*(bz planes + 2*halo planes + out block (+ extras like wave's prev)).
     budget = 11 * 1024 * 1024
     for bz in (32, 16, 8, 4, 2):
-        if z % bz:
+        if z % bz or bz % (2 * halo):
             continue
-        if 2 * (2 * bz + 2 + extra_planes) * plane_bytes <= budget:
+        if 2 * (2 * bz + 2 * halo + extra_planes) * plane_bytes <= budget:
             return bz
     return 0
 
 
-def _zchunk_specs(padded_shape, bz):
+def _zchunk_specs(padded_shape, bz, halo: int = 1):
     zp_, yp, xp = padded_shape
-    z, y, x = zp_ - 2, yp - 2, xp - 2
-    # chunk i needs padded planes [i*bz, i*bz + bz + 2): a bz-block at block
-    # index i plus a 2-plane tail block at element offset (i+1)*bz.
+    z, y, x = zp_ - 2 * halo, yp - 2 * halo, xp - 2 * halo
+    # chunk i needs padded planes [i*bz, i*bz + bz + 2*halo): a bz-block at
+    # block index i plus a 2*halo-plane tail block at element offset
+    # (i+1)*bz (block-aligned because bz % 2*halo == 0).
     zc = pl.BlockSpec((bz, yp, xp), lambda i: (i, 0, 0))
-    ztail = pl.BlockSpec((2, yp, xp), lambda i: ((i + 1) * bz // 2, 0, 0))
+    ztail = pl.BlockSpec(
+        (2 * halo, yp, xp), lambda i: ((i + 1) * bz // (2 * halo), 0, 0))
     out = pl.BlockSpec((bz, y, x), lambda i: (i, 0, 0))
     return zc, ztail, out
 
 
+_SLAB_TAPS = {
+    "heat3d": (_slab_taps_7, 1),
+    "heat3d27": (_slab_taps_27, 1),
+    "heat3d4th": (_slab_taps_13, 2),
+}
+
+
 def _heat3d_compute(stencil: Stencil, interpret: bool):
     alpha = float(stencil.params["alpha"])
-    taps = functools.partial(
-        _slab_taps_7 if stencil.name == "heat3d" else _slab_taps_27, alpha)
+    taps_fn, halo = _SLAB_TAPS[stencil.name]
+    taps = functools.partial(taps_fn, alpha)
 
     def compute(padded: Fields) -> Fields:
         (p,) = padded
         zp_, yp, xp = p.shape
-        z, y, x = zp_ - 2, yp - 2, xp - 2
-        bz = _pick_bz(z, yp * xp * p.dtype.itemsize)
+        z, y, x = zp_ - 2 * halo, yp - 2 * halo, xp - 2 * halo
+        bz = _pick_bz(z, yp * xp * p.dtype.itemsize, halo=halo)
         if bz == 0:
             return stencil.update(padded)  # shape unsuited: jnp path
-        zc, ztail, so = _zchunk_specs(p.shape, bz)
+        zc, ztail, so = _zchunk_specs(p.shape, bz, halo)
         res = pl.pallas_call(
             functools.partial(_zchunk_kernel, taps, bz),
             grid=(z // bz,),
@@ -235,6 +260,7 @@ def _whole2d_compute(stencil: Stencil, interpret: bool):
 _BUILDERS: dict = {
     "heat3d": _heat3d_compute,
     "heat3d27": _heat3d_compute,
+    "heat3d4th": _heat3d_compute,
     "wave3d": _wave3d_compute,
     "heat2d": _whole2d_compute,
     "life": _whole2d_compute,

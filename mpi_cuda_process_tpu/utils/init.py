@@ -100,6 +100,10 @@ def init_state(
     elif kind == "patch":
         # Reaction-diffusion seed: u ~ 1 background with a perturbed central
         # patch, v nonzero only inside the patch (Gray-Scott convention).
+        if stencil.num_fields < 2:
+            raise ValueError(
+                f"init kind 'patch' seeds an activator/inhibitor pair; "
+                f"{stencil.name} has {stencil.num_fields} field(s)")
         key = jax.random.PRNGKey(seed)
         centre = _gaussian_bump(grid_shape)
         patch = (centre > 0.5).astype(jnp.float32)

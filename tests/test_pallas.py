@@ -18,6 +18,8 @@ CASES = [
     ("heat3d", (16, 8, 10), {}),       # z divisible by a chunk size
     ("heat3d", (6, 8, 10), {}),        # z NOT divisible: jnp fallback path
     ("heat3d27", (16, 7, 8), {"alpha": 0.1}),
+    ("heat3d4th", (16, 9, 10), {"alpha": 0.05}),  # halo-2 z-chunk kernel
+    ("heat3d4th", (6, 9, 10), {"alpha": 0.05}),   # bz % 2*halo fails: fallback
     ("wave3d", (16, 8, 8), {"c2dt2": 0.1}),
 ]
 
