@@ -121,16 +121,15 @@ def bench_halo_overhead(st, mesh_shape, global_shape, steps, reps=3):
         init_state, make_mesh, make_sharded_step, shard_fields,
     )
     from mpi_cuda_process_tpu.driver import make_runner
-    from mpi_cuda_process_tpu.parallel.stepper import grid_partition_spec
-
-    from mpi_cuda_process_tpu.parallel.stepper import shard_map
+    from mpi_cuda_process_tpu.parallel.halo import exchange_and_pad
+    from mpi_cuda_process_tpu.parallel.stepper import (
+        grid_partition_spec, shard_map,
+    )
 
     mesh = make_mesh(mesh_shape)
     step = make_sharded_step(st, mesh, global_shape)
 
     # exchange-free control: same local compute, halo from BC constants only
-    from mpi_cuda_process_tpu.parallel.halo import exchange_and_pad
-
     ndim = st.ndim
 
     def local_only(fields):
