@@ -28,6 +28,18 @@ _CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # good measured record, the value the watchdog falls back to.
 _WATCHDOG_S = 420.0
 _done = threading.Event()
+_emit_lock = threading.Lock()
+_emitted = False
+
+
+def _emit(rec) -> None:
+    """Print the one result line exactly once (watchdog/main race-safe)."""
+    global _emitted
+    with _emit_lock:
+        if _emitted:
+            return
+        _emitted = True
+        print(json.dumps(rec), flush=True)
 
 
 def _watchdog():
@@ -36,12 +48,14 @@ def _watchdog():
     try:
         with open(_CACHE) as fh:
             rec = json.load(fh)
-        rec["note"] = "cached result: backend unresponsive this run"
+        rec["note"] = (
+            f"cached {rec.get('backend', 'unknown')}-backend result: "
+            "backend unresponsive this run")
     except Exception:
         rec = {"metric": "stencil_throughput_unmeasured",
                "value": 0.0, "unit": "Mcells/s", "vs_baseline": 0.0,
                "note": "backend unresponsive; no cached result"}
-    print(json.dumps(rec), flush=True)
+    _emit(rec)
     os._exit(0)
 
 
@@ -117,12 +131,12 @@ def main():
         try:
             tmp = _CACHE + ".tmp"
             with open(tmp, "w") as fh:
-                json.dump(rec, fh)
+                json.dump({**rec, "backend": backend}, fh)
             os.replace(tmp, _CACHE)
         except OSError:
             pass
-    print(json.dumps(rec))
     _done.set()
+    _emit(rec)
 
 
 if __name__ == "__main__":

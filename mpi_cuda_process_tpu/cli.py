@@ -65,6 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-every", type=int, default=0)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-backend", default="npy",
+                   choices=["npy", "orbax"],
+                   help="npy: host-gathered .npy files (single-host); "
+                        "orbax: per-shard sharded checkpointing (the only "
+                        "option when the state exceeds host memory)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--render", action="store_true",
                    help="ASCII-render the final grid")
@@ -105,6 +110,7 @@ def config_from_args(argv=None) -> RunConfig:
         mesh=a.mesh, seed=a.seed, density=a.density, init=a.init,
         periodic=a.periodic, log_every=a.log_every,
         checkpoint_every=a.checkpoint_every, checkpoint_dir=a.checkpoint_dir,
+        checkpoint_backend=a.checkpoint_backend,
         resume=a.resume, render=a.render, profile_dir=a.profile_dir,
         compute=a.compute, overlap=a.overlap, ensemble=a.ensemble,
         fuse=a.fuse, tol=a.tol, tol_check_every=a.tol_check_every,
@@ -133,6 +139,26 @@ def resolve_compute_fn(cfg: RunConfig, st):
     return make_pallas_compute(st) if use else None
 
 
+def _resume(cfg: RunConfig, fields):
+    """Load the latest checkpoint (format auto-detected) onto ``fields``.
+
+    ``fields`` carries the target structure/sharding: an Orbax restore lands
+    per-shard directly onto it (no host gather); an npy restore is re-placed
+    with the same shardings.  Returns ``(fields, start_step)``.
+    """
+    import numpy as _np
+
+    loaded, start_step, _ = checkpointing.load_any(
+        cfg.checkpoint_dir, target_fields=fields)
+    out = []
+    for cur, new in zip(fields, loaded):
+        if isinstance(new, _np.ndarray):
+            new = jax.device_put(jnp.asarray(new), cur.sharding)
+        out.append(new)
+    log.info("resumed from %s at step %d", cfg.checkpoint_dir, start_step)
+    return tuple(out), start_step
+
+
 def build(cfg: RunConfig):
     """Materialize (stencil, step_fn, fields, start_step) from a config."""
     params = dict(cfg.params)
@@ -141,14 +167,10 @@ def build(cfg: RunConfig):
     st = stencil_lib.make_stencil(cfg.stencil, **params)
 
     start_step = 0
-    if cfg.resume and cfg.checkpoint_dir and \
-            checkpointing.latest_step(cfg.checkpoint_dir) is not None:
-        np_fields, start_step, _ = checkpointing.load_checkpoint(cfg.checkpoint_dir)
-        fields = tuple(jnp.asarray(f) for f in np_fields)
-        log.info("resumed from %s at step %d", cfg.checkpoint_dir, start_step)
-    else:
-        fields = init_state(st, cfg.grid, cfg.seed, cfg.density, cfg.init,
-                            periodic=cfg.periodic, ensemble=cfg.ensemble)
+    fields = init_state(st, cfg.grid, cfg.seed, cfg.density, cfg.init,
+                        periodic=cfg.periodic, ensemble=cfg.ensemble)
+    resuming = (cfg.resume and cfg.checkpoint_dir
+                and checkpointing.checkpoint_format(cfg.checkpoint_dir))
 
     if cfg.ensemble and cfg.mesh and math.prod(cfg.mesh) > 1:
         raise ValueError("--ensemble currently excludes --mesh; "
@@ -169,12 +191,16 @@ def build(cfg: RunConfig):
                 f"--fuse {cfg.fuse} unsupported for {st.name} on grid "
                 f"{cfg.grid} (need a fused kernel, 2k % 8 == 0, and an "
                 f"aligned tiling)")
+        if resuming:
+            fields, start_step = _resume(cfg, fields)
         # fused step_fn advances cfg.fuse steps per call; run() accounts.
         return st, fused, fields, start_step
     compute_fn = resolve_compute_fn(cfg, st)
     if cfg.ensemble:
         step_fn = driver.make_ensemble_step(driver.make_step(
             st, cfg.grid, periodic=cfg.periodic, compute_fn=compute_fn))
+        if resuming:
+            fields, start_step = _resume(cfg, fields)
         return st, step_fn, fields, start_step
     if cfg.mesh and math.prod(cfg.mesh) > 1:
         m = mesh_lib.make_mesh(cfg.mesh)
@@ -185,6 +211,10 @@ def build(cfg: RunConfig):
     else:
         step_fn = driver.make_step(
             st, cfg.grid, periodic=cfg.periodic, compute_fn=compute_fn)
+    # Resume AFTER sharding so the restore lands on the target sharding
+    # (orbax: per-shard reads, no host gather).
+    if resuming:
+        fields, start_step = _resume(cfg, fields)
     return st, step_fn, fields, start_step
 
 
@@ -197,11 +227,19 @@ def _profiled(cfg: RunConfig):
     return contextlib.nullcontext()
 
 
+def _save_ckpt(cfg: RunConfig, fields, step: int):
+    if cfg.checkpoint_backend == "orbax":
+        checkpointing.orbax_save_checkpoint(
+            cfg.checkpoint_dir, fields, step, dataclasses.asdict(cfg))
+    else:
+        checkpointing.save_checkpoint(
+            cfg.checkpoint_dir, fields, step, dataclasses.asdict(cfg))
+
+
 def _epilogue(cfg: RunConfig, fields, final_step: int, save_ckpt: bool):
     """Shared run tail: final checkpoint + optional ASCII render."""
     if save_ckpt and cfg.checkpoint_dir:
-        checkpointing.save_checkpoint(
-            cfg.checkpoint_dir, fields, final_step, dataclasses.asdict(cfg))
+        _save_ckpt(cfg, fields, final_step)
     if cfg.render:
         print(render.ascii_render(np.asarray(fields[0])))
 
@@ -245,8 +283,7 @@ def run(cfg: RunConfig) -> Tuple:
             log.info("step %d  %s", step, diagnostics.format_diagnostics(d))
         if cfg.checkpoint_every and cfg.checkpoint_dir and \
                 step % cfg.checkpoint_every == 0:
-            checkpointing.save_checkpoint(
-                cfg.checkpoint_dir, fs, step, dataclasses.asdict(cfg))
+            _save_ckpt(cfg, fs, step)
         if cfg.dump_every and cfg.dump_dir and \
                 step % cfg.dump_every == 0:
             native.async_write_npy(

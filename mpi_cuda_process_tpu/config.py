@@ -29,6 +29,7 @@ class RunConfig:
     log_every: int = 0
     checkpoint_every: int = 0
     checkpoint_dir: Optional[str] = None
+    checkpoint_backend: str = "npy"  # npy (host gather) | orbax (per-shard)
     resume: bool = False
     render: bool = False
     profile_dir: Optional[str] = None
