@@ -15,7 +15,7 @@ from .ops.stencil import Stencil, available_stencils, make_stencil
 from .parallel.halo import exchange_and_pad
 from .parallel.mesh import make_mesh, spatial_axis_names
 from .parallel.stepper import make_sharded_step, shard_fields
-from .utils.init import init_state
+from .utils.init import init_state, init_state_sharded
 
 __version__ = "0.1.0"
 
@@ -25,6 +25,7 @@ __all__ = [
     "available_stencils",
     "exchange_and_pad",
     "init_state",
+    "init_state_sharded",
     "make_mesh",
     "make_runner",
     "make_sharded_step",

@@ -34,7 +34,7 @@ from .parallel import stepper as stepper_lib
 import os
 
 from .utils import checkpointing, diagnostics, native, render
-from .utils.init import init_state
+from .utils.init import init_state, init_state_sharded
 
 log = logging.getLogger("mpi_cuda_process_tpu")
 
@@ -139,21 +139,30 @@ def resolve_compute_fn(cfg: RunConfig, st):
     return make_pallas_compute(st) if use else None
 
 
-def _resume(cfg: RunConfig, fields):
-    """Load the latest checkpoint (format auto-detected) onto ``fields``.
+def _abstract_fields(st, cfg: RunConfig, sharding):
+    """ShapeDtypeStruct targets for a resume — nothing is materialized."""
+    shape = (cfg.ensemble, *cfg.grid) if cfg.ensemble else tuple(cfg.grid)
+    return tuple(jax.ShapeDtypeStruct(shape, st.dtype, sharding=sharding)
+                 for _ in range(st.num_fields))
 
-    ``fields`` carries the target structure/sharding: an Orbax restore lands
-    per-shard directly onto it (no host gather); an npy restore is re-placed
-    with the same shardings.  Returns ``(fields, start_step)``.
+
+def _resume(cfg: RunConfig, targets):
+    """Load the latest checkpoint (format auto-detected) onto ``targets``.
+
+    ``targets`` are abstract ShapeDtypeStructs carrying the run's shardings:
+    an Orbax restore lands per-shard directly onto them (re-sharding across
+    meshes, no host gather); an npy restore is re-placed onto the same
+    shardings.  Returns ``(fields, start_step)``.
     """
-    import numpy as _np
-
+    sharded = all(t.sharding is not None for t in targets)
     loaded, start_step, _ = checkpointing.load_any(
-        cfg.checkpoint_dir, target_fields=fields)
+        cfg.checkpoint_dir, target_fields=targets if sharded else None)
     out = []
-    for cur, new in zip(fields, loaded):
-        if isinstance(new, _np.ndarray):
-            new = jax.device_put(jnp.asarray(new), cur.sharding)
+    for tgt, new in zip(targets, loaded):
+        if isinstance(new, np.ndarray):
+            new = jnp.asarray(new)
+            if tgt.sharding is not None:
+                new = jax.device_put(new, tgt.sharding)
         out.append(new)
     log.info("resumed from %s at step %d", cfg.checkpoint_dir, start_step)
     return tuple(out), start_step
@@ -167,10 +176,29 @@ def build(cfg: RunConfig):
     st = stencil_lib.make_stencil(cfg.stencil, **params)
 
     start_step = 0
-    fields = init_state(st, cfg.grid, cfg.seed, cfg.density, cfg.init,
-                        periodic=cfg.periodic, ensemble=cfg.ensemble)
+    use_mesh = bool(cfg.mesh) and math.prod(cfg.mesh) > 1 and not cfg.ensemble
+    m = mesh_lib.make_mesh(cfg.mesh) if use_mesh and not cfg.fuse else None
     resuming = (cfg.resume and cfg.checkpoint_dir
                 and checkpointing.checkpoint_format(cfg.checkpoint_dir))
+    if resuming:
+        # Only shapes/dtypes/shardings are needed: the checkpoint supplies
+        # the values, so no initial state is computed at all.
+        sharding = None
+        if m is not None:
+            from jax.sharding import NamedSharding
+
+            sharding = NamedSharding(
+                m, stepper_lib.grid_partition_spec(st.ndim, m))
+        fields = _abstract_fields(st, cfg, sharding)
+    elif m is not None:
+        # Shard-native init: each device computes its own block; no process
+        # materializes the full grid (utils/init.py::init_state_sharded).
+        fields = init_state_sharded(
+            st, cfg.grid, m, cfg.seed, cfg.density, cfg.init,
+            periodic=cfg.periodic)
+    else:
+        fields = init_state(st, cfg.grid, cfg.seed, cfg.density, cfg.init,
+                            periodic=cfg.periodic, ensemble=cfg.ensemble)
 
     if cfg.ensemble and cfg.mesh and math.prod(cfg.mesh) > 1:
         raise ValueError("--ensemble currently excludes --mesh; "
@@ -202,12 +230,10 @@ def build(cfg: RunConfig):
         if resuming:
             fields, start_step = _resume(cfg, fields)
         return st, step_fn, fields, start_step
-    if cfg.mesh and math.prod(cfg.mesh) > 1:
-        m = mesh_lib.make_mesh(cfg.mesh)
+    if use_mesh:
         step_fn = stepper_lib.make_sharded_step(
             st, m, cfg.grid, periodic=cfg.periodic, compute_fn=compute_fn,
             overlap=cfg.overlap)
-        fields = stepper_lib.shard_fields(fields, m, st.ndim)
     else:
         step_fn = driver.make_step(
             st, cfg.grid, periodic=cfg.periodic, compute_fn=compute_fn)

@@ -81,13 +81,18 @@ def load_checkpoint(path: str) -> Tuple[Tuple[np.ndarray, ...], int, Dict]:
     return fields, meta["step"], meta.get("config", {})
 
 
-def latest_step(path: str) -> Optional[int]:
+def _npy_step(path: str) -> Optional[int]:
     try:
         with open(os.path.join(path, _META)) as fh:
             return int(json.load(fh)["step"])
     except (OSError, ValueError, KeyError):
-        pass
-    return orbax_latest_step(path)
+        return None
+
+
+def latest_step(path: str) -> Optional[int]:
+    steps = [s for s in (_npy_step(path), orbax_latest_step(path))
+             if s is not None]
+    return max(steps) if steps else None
 
 
 # ---------------------------------------------------------------------------
@@ -105,13 +110,18 @@ def checkpoint_format(path: str) -> Optional[str]:
     """Detect the on-disk checkpoint format: 'npy', 'orbax', or None.
 
     Saving uses the configured backend; loading trusts the directory, so a
-    resume never crashes on a backend-flag mismatch.
+    resume never crashes on a backend-flag mismatch.  When BOTH formats are
+    present (a run switched backends mid-stream into the same dir), the one
+    holding the newest step wins — never silently resume older state.
     """
-    if os.path.exists(os.path.join(path, _META)):
+    n, o = _npy_step(path), orbax_latest_step(path)
+    if n is None and o is None:
+        return None
+    if o is None:
         return "npy"
-    if _orbax_steps(path):
+    if n is None:
         return "orbax"
-    return None
+    return "npy" if n >= o else "orbax"
 
 
 def load_any(path: str, target_fields=None):
@@ -175,9 +185,10 @@ def orbax_latest_step(path: str) -> Optional[int]:
 def orbax_load_checkpoint(path: str, target_fields=None):
     """Restore the latest Orbax checkpoint.
 
-    ``target_fields`` (abstract or concrete arrays with shardings) makes the
-    restore re-shard directly onto the target mesh — no host gather.  Returns
-    ``(fields, step, config)`` like :func:`load_checkpoint`.
+    ``target_fields`` (abstract ``ShapeDtypeStruct``s or concrete arrays,
+    with shardings) makes the restore land per-shard directly on the target
+    sharding — re-sharding across a different mesh/topology, no host gather.
+    Returns ``(fields, step, config)`` like :func:`load_checkpoint`.
     """
     ocp = _orbax()
     path = os.path.abspath(path)
@@ -186,18 +197,23 @@ def orbax_load_checkpoint(path: str, target_fields=None):
         raise FileNotFoundError(f"no orbax checkpoint under {path}")
     if target_fields is not None:
         abstract = [
-            jax.tree_util.tree_map(
-                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                               sharding=x.sharding), f)
-            for f in target_fields
+            jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            for x in target_fields
         ]
-        restore_args = ocp.args.PyTreeRestore(abstract)
+        # construct_restore_args is what actually carries the shardings into
+        # the restore; PyTreeRestore(item) alone does NOT (orbax would fall
+        # back to the on-disk sharding file).
+        restore = ocp.args.PyTreeRestore(
+            item=abstract,
+            restore_args=ocp.checkpoint_utils.construct_restore_args(
+                abstract),
+        )
     else:
-        restore_args = ocp.args.PyTreeRestore()
+        restore = ocp.args.PyTreeRestore()
     with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as ckptr:
         out = ckptr.restore(
             os.path.join(path, f"step_{step:012d}"),
-            args=ocp.args.Composite(state=restore_args,
+            args=ocp.args.Composite(state=restore,
                                     meta=ocp.args.JsonRestore()),
         )
     meta = out["meta"]

@@ -152,6 +152,46 @@ def test_orbax_sharded_roundtrip(tmp_path):
     np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(fields[0]))
 
 
+def test_orbax_restore_reshards_across_meshes(tmp_path):
+    """Restore onto a DIFFERENT mesh must land on the target sharding."""
+    import jax
+
+    from mpi_cuda_process_tpu import (
+        init_state, make_mesh, make_stencil, shard_fields)
+
+    st = make_stencil("heat3d")
+    grid = (8, 8, 8)
+    mesh8 = make_mesh((2, 2, 2))
+    fields8 = shard_fields(init_state(st, grid, kind="zero"), mesh8, 3)
+    p = str(tmp_path / "xmesh")
+    checkpointing.orbax_save_checkpoint(p, fields8, 3)
+
+    mesh4 = make_mesh((2, 2))
+    target = shard_fields(init_state(st, grid, kind="zero"), mesh4, 3)
+    out, step, _ = checkpointing.orbax_load_checkpoint(
+        p, target_fields=target)
+    assert step == 3
+    assert out[0].sharding == target[0].sharding  # 4-device target, not 8
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(fields8[0]))
+
+
+def test_checkpoint_format_prefers_newest_step(tmp_path):
+    """Backend switch mid-run: the format holding the newest step wins."""
+    import jax.numpy as _jnp
+
+    p = str(tmp_path / "both")
+    f = (_jnp.zeros((4, 4), _jnp.float32),)
+    checkpointing.save_checkpoint(p, f, 6)          # npy at step 6
+    checkpointing.orbax_save_checkpoint(p, f, 12)   # orbax at step 12
+    assert checkpointing.checkpoint_format(p) == "orbax"
+    assert checkpointing.latest_step(p) == 12
+    _, step, _ = checkpointing.load_any(p)
+    assert step == 12
+    checkpointing.save_checkpoint(p, f, 20)         # npy pulls ahead
+    assert checkpointing.checkpoint_format(p) == "npy"
+    assert checkpointing.latest_step(p) == 20
+
+
 def test_ensemble_matches_independent_runs():
     """vmapped ensemble == N independent runs with seeds seed..seed+N-1."""
     base = dict(stencil="life", grid=(16, 16), iters=5)
