@@ -10,7 +10,7 @@ communication/computation overlap — see SURVEY.md for the full blueprint.
 
 from .config import RunConfig
 from .driver import make_runner, make_step, run_simulation
-from .ops import advection, heat, life, reaction, wave  # noqa: F401  (register stencils)
+from .ops import advection, heat, life, reaction, sor, wave  # noqa: F401  (register stencils)
 from .ops.stencil import Stencil, available_stencils, make_stencil
 from .parallel.halo import exchange_and_pad
 from .parallel.mesh import make_mesh, spatial_axis_names

@@ -28,7 +28,7 @@ import numpy as np
 from . import driver
 from .config import RunConfig, parse_int_tuple, parse_params
 from .ops import stencil as stencil_lib
-from .ops import advection, heat, life, reaction, wave  # noqa: F401  (populate the registry)
+from .ops import advection, heat, life, reaction, sor, wave  # noqa: F401  (populate the registry)
 from .parallel import mesh as mesh_lib
 from .parallel import stepper as stepper_lib
 import os
@@ -154,9 +154,8 @@ def _resume(cfg: RunConfig, targets):
     meshes, no host gather); an npy restore is re-placed onto the same
     shardings.  Returns ``(fields, start_step)``.
     """
-    sharded = all(t.sharding is not None for t in targets)
     loaded, start_step, _ = checkpointing.load_any(
-        cfg.checkpoint_dir, target_fields=targets if sharded else None)
+        cfg.checkpoint_dir, target_fields=targets)
     out = []
     for tgt, new in zip(targets, loaded):
         if isinstance(new, np.ndarray):
@@ -182,13 +181,17 @@ def build(cfg: RunConfig):
                 and checkpointing.checkpoint_format(cfg.checkpoint_dir))
     if resuming:
         # Only shapes/dtypes/shardings are needed: the checkpoint supplies
-        # the values, so no initial state is computed at all.
-        sharding = None
-        if m is not None:
-            from jax.sharding import NamedSharding
+        # the values, so no initial state is computed at all.  Unsharded
+        # runs still carry a concrete single-device sharding so an orbax
+        # restore re-shards onto THIS run's placement (never the on-disk
+        # mesh, which may not exist here).
+        from jax.sharding import NamedSharding, SingleDeviceSharding
 
+        if m is not None:
             sharding = NamedSharding(
                 m, stepper_lib.grid_partition_spec(st.ndim, m))
+        else:
+            sharding = SingleDeviceSharding(jax.devices()[0])
         fields = _abstract_fields(st, cfg, sharding)
     elif m is not None:
         # Shard-native init: each device computes its own block; no process

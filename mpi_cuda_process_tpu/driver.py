@@ -71,9 +71,17 @@ def make_step(
     """
     ndim = stencil.ndim
     zeros = (0,) * ndim
-    update = compute_fn or stencil.update
+    if stencil.phases and compute_fn is not None:
+        raise ValueError(
+            f"{stencil.name} is multi-phase; compute_fn override unsupported")
+    if stencil.parity_sensitive and periodic and \
+            any(g % 2 for g in global_shape):
+        raise ValueError(
+            f"{stencil.name} is parity-sensitive: periodic wrap over odd "
+            f"extents {tuple(global_shape)} makes the coloring inconsistent")
+    update_fns = stencil.phases or (compute_fn or stencil.update,)
 
-    def step(fields: Fields) -> Fields:
+    def one_pass(fields: Fields, update) -> Fields:
         padded = []
         for f, v, fh in zip(fields, stencil.bc_value, stencil.field_halos):
             if fh == 0:
@@ -99,6 +107,13 @@ def make_step(
                         fields[0].shape, global_shape, zeros, stencil.halo)
                 out.append(jnp.where(mask, fields[i], nf))
         return tuple(out)
+
+    def step(fields: Fields) -> Fields:
+        # One time step = every phase in order, each with fresh padding
+        # (single-phase stencils: exactly the old pad -> update -> re-pin).
+        for upd in update_fns:
+            fields = one_pass(fields, upd)
+        return fields
 
     return step
 

@@ -73,6 +73,17 @@ class Stencil:
     # the stepper takes old field j instead of update's i-th output (which is
     # never materialized).  Wave: (None, 0) — new u_prev is old u, zero cost.
     carry_map: Tuple[Optional[int], ...] = None  # type: ignore[assignment]
+    # Multi-phase steps: when set, ONE time step = this sequence of update
+    # fns, each preceded by its own halo exchange/pad (so phase k sees phase
+    # k-1's values from neighbor shards — exact red-black/Gauss-Seidel
+    # sweeps under domain decomposition).  ``update`` is then unused by the
+    # steppers and may be a stub.
+    phases: Optional[Tuple[UpdateFn, ...]] = None
+    # True when the update depends on block-local coordinate PARITY (e.g.
+    # red-black coloring): decompositions with odd per-shard extents (and
+    # periodic wraps over odd global extents) would flip colors, so the
+    # steppers must reject them.
+    parity_sensitive: bool = False
 
     def __post_init__(self):
         if self.field_halos is None:

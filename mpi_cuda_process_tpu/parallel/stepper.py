@@ -93,7 +93,23 @@ def make_sharded_step(
         raise ValueError(
             f"local block {local_shape} smaller than halo {halo}"
         )
-    update = compute_fn or stencil.update
+    if stencil.phases:
+        if compute_fn is not None:
+            raise ValueError(
+                f"{stencil.name} is multi-phase; compute_fn unsupported")
+        if overlap:
+            raise ValueError(
+                f"{stencil.name} is multi-phase; overlap split unsupported")
+    if stencil.parity_sensitive:
+        bad = [d for d, c in enumerate(counts)
+               if c > 1 and local_shape[d] % 2]
+        if bad:
+            raise ValueError(
+                f"{stencil.name} is parity-sensitive (red-black coloring): "
+                f"sharded axes {bad} have odd per-shard extents "
+                f"{[local_shape[d] for d in bad]}, which would flip colors "
+                f"across shards — use even per-axis block sizes")
+    update_fns = stencil.phases or (compute_fn or stencil.update,)
     spec = grid_partition_spec(ndim, mesh)
 
     sharded_axes = [d for d, c in enumerate(counts) if c > 1]
@@ -104,7 +120,7 @@ def make_sharded_step(
         idx[d] = sl
         return x[tuple(idx)]
 
-    def _ring_update(padded, fields, d, lo: bool):
+    def _ring_update(update, padded, fields, d, lo: bool):
         """Update of the width-halo boundary ring at face (d, lo/hi)."""
         slabs = []
         for pf, f, fh in zip(padded, fields, stencil.field_halos):
@@ -116,7 +132,7 @@ def make_sharded_step(
                 slabs.append(_axis_slice(pf, d, sl))
         return update(tuple(slabs))
 
-    def local_step(fields: Fields) -> Fields:
+    def one_pass(fields: Fields, update) -> Fields:
         padded = tuple(
             exchange_and_pad(f, axis_names, counts, fh, bc, periodic)
             for f, bc, fh in zip(
@@ -136,8 +152,8 @@ def make_sharded_step(
                 bulk = list(update(local_padded))
             with jax.named_scope("boundary_update"):
                 for d in sharded_axes:
-                    ring_lo = _ring_update(padded, fields, d, True)
-                    ring_hi = _ring_update(padded, fields, d, False)
+                    ring_lo = _ring_update(update, padded, fields, d, True)
+                    ring_hi = _ring_update(update, padded, fields, d, False)
                     for i in range(len(bulk)):
                         if stencil.carry_map[i] is not None:
                             continue
@@ -169,6 +185,14 @@ def make_sharded_step(
                     mask = frame_mask(local_shape, global_shape, offsets, halo)
                 out.append(jnp.where(mask, fields[i], nf))
         return tuple(out)
+
+    def local_step(fields: Fields) -> Fields:
+        # One time step = every phase in order, each with its own halo
+        # exchange (phase k sees phase k-1's values from neighbor shards —
+        # exact red-black sweeps under decomposition).
+        for upd in update_fns:
+            fields = one_pass(fields, upd)
+        return fields
 
     # check_vma=False: pallas_call outputs carry no varying-mesh-axes
     # annotation, which the default vma check rejects inside shard_map.

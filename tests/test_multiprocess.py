@@ -31,7 +31,7 @@ from mpi_cuda_process_tpu import make_sharded_step, make_stencil
 from mpi_cuda_process_tpu.driver import make_runner
 from mpi_cuda_process_tpu.utils.init import init_state_sharded
 
-ok = bootstrap_distributed(coordinator_address=f"localhost:{{port}}".format(port=port),
+ok = bootstrap_distributed(coordinator_address=f"localhost:{{port}}",
                            num_processes=2, process_id=rank, init_timeout_s=120)
 assert ok and jax.process_count() == 2 and jax.device_count() == 2
 
@@ -45,8 +45,7 @@ out = make_runner(step, 5)(fields)
 total = int(jax.numpy.sum(out[0]))  # replicated global reduction
 pop0 = int(jax.numpy.sum(init_state_sharded(
     st, grid, mesh, seed=7, density=0.3, kind="random")[0]))
-print(f"RESULT rank={{rank}} pop0={{pop0}} total={{total}}".format(
-    rank=rank, pop0=pop0, total=total), flush=True)
+print(f"RESULT rank={{rank}} pop0={{pop0}} total={{total}}", flush=True)
 """
 
 
