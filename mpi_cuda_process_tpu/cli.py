@@ -90,6 +90,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="local block update implementation (auto: jnp for "
                         "7-point-class stencils where XLA fuses to roofline, "
                         "pallas where the hand kernel wins)")
+    p.add_argument("--check-finite", type=int, default=0,
+                   help="every N steps, verify all fields are finite and "
+                        "abort with the failing step range if not (debug "
+                        "sanitizer for blow-ups: NaN/Inf from unstable "
+                        "parameters)")
     p.add_argument("--tol", type=float, default=0.0,
                    help="stop when the residual max|u - u_prev_check| over a "
                         "--tol-check-every-step interval drops below TOL "
@@ -114,6 +119,7 @@ def config_from_args(argv=None) -> RunConfig:
         resume=a.resume, render=a.render, profile_dir=a.profile_dir,
         compute=a.compute, overlap=a.overlap, ensemble=a.ensemble,
         fuse=a.fuse, tol=a.tol, tol_check_every=a.tol_check_every,
+        check_finite=a.check_finite,
         dump_every=a.dump_every, dump_dir=a.dump_dir,
         params=parse_params(a.param),
     )
@@ -285,9 +291,13 @@ def run(cfg: RunConfig) -> Tuple:
     cells = math.prod(cfg.grid) * max(1, cfg.ensemble)
 
     if cfg.tol > 0:
-        if cfg.fuse or cfg.log_every or cfg.checkpoint_every or cfg.dump_every:
-            raise ValueError("--tol runs inside one while_loop; it excludes "
-                             "--fuse and periodic log/checkpoint/dump")
+        if cfg.fuse or cfg.log_every or cfg.checkpoint_every or \
+                cfg.dump_every or cfg.check_finite:
+            raise ValueError(
+                "--tol runs inside one while_loop; it excludes --fuse and "
+                "periodic log/checkpoint/dump/check-finite (a non-finite "
+                "state never converges: the residual stays NaN>tol and the "
+                "loop exits at the --iters cap)")
         t0 = time.perf_counter()
         with _profiled(cfg):
             fields, n_done, res = driver.run_until(
@@ -305,8 +315,20 @@ def run(cfg: RunConfig) -> Tuple:
     if cfg.dump_every and cfg.dump_dir:
         os.makedirs(cfg.dump_dir, exist_ok=True)
 
+    last_ok = [start_step]
+
     def callback(done_in_run, fs):
         step = start_step + done_in_run * max(1, cfg.fuse)
+        if cfg.check_finite and step % cfg.check_finite == 0:
+            for i, f in enumerate(fs):
+                if not jnp.issubdtype(f.dtype, jnp.inexact):
+                    continue  # int grids cannot hold NaN/Inf
+                if not bool(jnp.isfinite(f).all()):
+                    raise RuntimeError(
+                        f"field {i} became non-finite between steps "
+                        f"{last_ok[0]} and {step} (NaN/Inf blow-up — "
+                        f"check stability parameters)")
+            last_ok[0] = step
         if cfg.log_every and step % cfg.log_every == 0:
             d = diagnostics.field_diagnostics(st, fs)
             log.info("step %d  %s", step, diagnostics.format_diagnostics(d))
@@ -320,6 +342,7 @@ def run(cfg: RunConfig) -> Tuple:
                 np.asarray(fs[0]))
 
     intervals = [v for v in (cfg.log_every, cfg.checkpoint_every,
+                             cfg.check_finite,
                              cfg.dump_every if cfg.dump_dir else 0) if v]
     interval = math.gcd(*intervals) if len(intervals) > 1 else (
         intervals[0] if intervals else 0)

@@ -109,6 +109,11 @@ def make_sharded_step(
                 f"sharded axes {bad} have odd per-shard extents "
                 f"{[local_shape[d] for d in bad]}, which would flip colors "
                 f"across shards — use even per-axis block sizes")
+        if periodic and any(g % 2 for g in global_shape):
+            raise ValueError(
+                f"{stencil.name} is parity-sensitive: periodic wrap over "
+                f"odd extents {tuple(global_shape)} makes the coloring "
+                f"inconsistent")
     update_fns = stencil.phases or (compute_fn or stencil.update,)
     spec = grid_partition_spec(ndim, mesh)
 

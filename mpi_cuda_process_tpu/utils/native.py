@@ -127,3 +127,31 @@ def heat3d_step_native(grid: np.ndarray, alpha: float) -> np.ndarray:
         ctypes.c_int64(a.shape[0]), ctypes.c_int64(a.shape[1]),
         ctypes.c_int64(a.shape[2]), ctypes.c_float(alpha))
     return out
+
+
+def _step_2d_native(fn_name: str, grid: np.ndarray, *scalars) -> np.ndarray:
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    a = np.ascontiguousarray(grid, dtype=np.float32)
+    out = np.empty_like(a)
+    getattr(lib, fn_name)(
+        a.ctypes.data_as(ctypes.c_void_p), out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(a.shape[0]), ctypes.c_int64(a.shape[1]),
+        *(ctypes.c_float(s) for s in scalars))
+    return out
+
+
+def heat2d_step_native(grid: np.ndarray, alpha: float) -> np.ndarray:
+    """Independent C++ 5-point FTCS step (the reference MDF workload)."""
+    return _step_2d_native("stencilhost_heat2d_step", grid, alpha)
+
+
+def advect2d_step_native(grid: np.ndarray, cy: float, cx: float) -> np.ndarray:
+    """Independent C++ first-order upwind advection step."""
+    return _step_2d_native("stencilhost_advect2d_step", grid, cy, cx)
+
+
+def sor2d_step_native(grid: np.ndarray, omega: float) -> np.ndarray:
+    """Independent C++ red-black SOR step (Gauss-Seidel semantics)."""
+    return _step_2d_native("stencilhost_sor2d_step", grid, omega)

@@ -228,4 +228,59 @@ void stencilhost_heat3d_step(const float* in, float* out, int64_t d, int64_t h,
   }
 }
 
+// One 5-point FTCS diffusion step on an h x w float32 grid, frame fixed
+// (the reference MDF workload, MDF_kernel.cu:20's formula class).
+void stencilhost_heat2d_step(const float* in, float* out, int64_t h, int64_t w,
+                             float alpha) {
+  std::memcpy(out, in, sizeof(float) * static_cast<size_t>(h * w));
+  for (int64_t y = 1; y + 1 < h; ++y) {
+    for (int64_t x = 1; x + 1 < w; ++x) {
+      int64_t i = y * w + x;
+      float u = in[i];
+      float lap = in[i - 1] + in[i + 1] + in[i - w] + in[i + w] - 4.0f * u;
+      out[i] = u + alpha * lap;
+    }
+  }
+}
+
+// One first-order upwind advection step (2D), frame fixed.  cy/cx are the
+// signed Courant numbers for grid axes 0/1.
+void stencilhost_advect2d_step(const float* in, float* out, int64_t h,
+                               int64_t w, float cy, float cx) {
+  std::memcpy(out, in, sizeof(float) * static_cast<size_t>(h * w));
+  for (int64_t y = 1; y + 1 < h; ++y) {
+    for (int64_t x = 1; x + 1 < w; ++x) {
+      int64_t i = y * w + x;
+      float u = in[i];
+      float acc = u;
+      if (cy > 0)
+        acc -= cy * (u - in[i - w]);
+      else if (cy < 0)
+        acc -= cy * (in[i + w] - u);
+      if (cx > 0)
+        acc -= cx * (u - in[i - 1]);
+      else if (cx < 0)
+        acc -= cx * (in[i + 1] - u);
+      out[i] = acc;
+    }
+  }
+}
+
+// One red-black SOR step (2D Laplace): red half-sweep (even coordinate
+// parity) then black, the black sweep reading fresh red values; frame fixed.
+void stencilhost_sor2d_step(const float* in, float* out, int64_t h, int64_t w,
+                            float omega) {
+  std::memcpy(out, in, sizeof(float) * static_cast<size_t>(h * w));
+  for (int color = 0; color < 2; ++color) {
+    for (int64_t y = 1; y + 1 < h; ++y) {
+      for (int64_t x = 1; x + 1 < w; ++x) {
+        if (((y + x) & 1) != color) continue;
+        int64_t i = y * w + x;
+        float nsum = out[i - 1] + out[i + 1] + out[i - w] + out[i + w];
+        out[i] = (1.0f - omega) * out[i] + omega * 0.25f * nsum;
+      }
+    }
+  }
+}
+
 }  // extern "C"

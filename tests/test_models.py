@@ -191,6 +191,22 @@ def test_run_until_sharded():
     assert np.asarray(out[0]).min() > 95.0
 
 
+def test_check_finite_catches_blowup():
+    """--check-finite aborts with the failing step range on NaN/Inf."""
+    from mpi_cuda_process_tpu.cli import run
+    from mpi_cuda_process_tpu.config import RunConfig
+
+    # wildly unstable wave (c2dt2 >> 1/3) blows up within a few steps
+    with pytest.raises(RuntimeError, match="non-finite between steps"):
+        run(RunConfig(stencil="wave3d", grid=(16, 16, 16), iters=200,
+                      init="pulse", params={"c2dt2": 50.0}, check_finite=20))
+
+    # stable run with the same flag completes untouched
+    fields, _ = run(RunConfig(stencil="wave3d", grid=(16, 16, 16), iters=40,
+                              init="pulse", check_finite=20))
+    assert np.isfinite(np.asarray(fields[0])).all()
+
+
 def test_cli_tol_path():
     from mpi_cuda_process_tpu.cli import run
     from mpi_cuda_process_tpu.config import RunConfig
