@@ -2,23 +2,25 @@
 
 The TPU-world equivalent of testing MPI code without mpirun (SURVEY.md §4.4):
 ``--xla_force_host_platform_device_count=8`` gives every mesh / sharding /
-ppermute test 8 fake devices on one host.  Must be set before jax imports.
+ppermute test 8 fake devices on one host.  The CPU-forcing recipe (env vars
+plus the in-process ``jax.config.update`` that beats the axon sitecustomize)
+lives in repo-root ``cpuforce.py`` — shared with ``__graft_entry__``'s
+hermetic dryrun child — which deliberately does NOT import the package, so
+env vars are set before any framework (and hence jax-backend) code runs.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# The axon TPU sitecustomize force-selects its platform via jax.config after
-# register(), which overrides JAX_PLATFORMS — override it back to CPU here
-# (before any backend is initialized, so XLA_FLAGS still applies).
-import jax  # noqa: E402
+from cpuforce import force_cpu  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-
+# Leave an explicit pre-set device count untouched so an outer harness can
+# choose its own count via XLA_FLAGS.
+_n = (
+    None
+    if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", "")
+    else 8
+)
+force_cpu(_n)
