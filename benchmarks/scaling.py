@@ -39,15 +39,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def _setup_devices(virtual: int):
     if virtual:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count={virtual}"
-            ).strip()
+        # Shared anti-sitecustomize recipe (repo-root cpuforce.py); only
+        # effective if the jax backend is not yet initialized.
+        from cpuforce import force_cpu
+
+        force_cpu(virtual)
     import jax
 
-    if virtual:
-        jax.config.update("jax_platforms", "cpu")
     return jax
 
 
