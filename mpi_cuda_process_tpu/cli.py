@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import functools
 import logging
 import math
 import sys
@@ -95,6 +96,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "abort with the failing step range if not (debug "
                         "sanitizer for blow-ups: NaN/Inf from unstable "
                         "parameters)")
+    p.add_argument("--debug-checks", action="store_true",
+                   help="checkify debug mode: every step asserts all fields "
+                        "finite inside the jitted scan (the error names the "
+                        "exact failing step) plus index bounds checks; "
+                        "slower — complements --check-finite's polling")
     p.add_argument("--tol", type=float, default=0.0,
                    help="stop when the residual max|u - u_prev_check| over a "
                         "--tol-check-every-step interval drops below TOL "
@@ -119,7 +125,7 @@ def config_from_args(argv=None) -> RunConfig:
         resume=a.resume, render=a.render, profile_dir=a.profile_dir,
         compute=a.compute, overlap=a.overlap, ensemble=a.ensemble,
         fuse=a.fuse, tol=a.tol, tol_check_every=a.tol_check_every,
-        check_finite=a.check_finite,
+        check_finite=a.check_finite, debug_checks=a.debug_checks,
         dump_every=a.dump_every, dump_dir=a.dump_dir,
         params=parse_params(a.param),
     )
@@ -128,6 +134,11 @@ def config_from_args(argv=None) -> RunConfig:
 # Stencils whose Pallas kernel beats XLA's fusion on TPU (measured); all
 # others fuse to ~HBM roofline already and default to the jnp path.
 _PALLAS_WINS = {"heat3d27"}
+
+
+def _uses_mesh(cfg: RunConfig) -> bool:
+    """Whether this run decomposes over a device mesh (sharded step_fn)."""
+    return bool(cfg.mesh) and math.prod(cfg.mesh) > 1 and not cfg.ensemble
 
 
 def resolve_compute_fn(cfg: RunConfig, st):
@@ -181,7 +192,7 @@ def build(cfg: RunConfig):
     st = stencil_lib.make_stencil(cfg.stencil, **params)
 
     start_step = 0
-    use_mesh = bool(cfg.mesh) and math.prod(cfg.mesh) > 1 and not cfg.ensemble
+    use_mesh = _uses_mesh(cfg)
     m = mesh_lib.make_mesh(cfg.mesh) if use_mesh and not cfg.fuse else None
     resuming = (cfg.resume and cfg.checkpoint_dir
                 and checkpointing.checkpoint_format(cfg.checkpoint_dir))
@@ -281,6 +292,9 @@ def _epilogue(cfg: RunConfig, fields, final_step: int, save_ckpt: bool):
 
 def run(cfg: RunConfig) -> Tuple:
     """Execute a configured run; returns (final_fields, mcells_per_s)."""
+    if cfg.debug_checks and cfg.fuse:
+        raise ValueError("--debug-checks excludes --fuse (the fused "
+                         "kernel replaces the step being instrumented)")
     mesh_lib.bootstrap_distributed()
     st, step_fn, fields, start_step = build(cfg)
     remaining = cfg.iters - start_step
@@ -292,12 +306,13 @@ def run(cfg: RunConfig) -> Tuple:
 
     if cfg.tol > 0:
         if cfg.fuse or cfg.log_every or cfg.checkpoint_every or \
-                cfg.dump_every or cfg.check_finite:
+                cfg.dump_every or cfg.check_finite or cfg.debug_checks:
             raise ValueError(
-                "--tol runs inside one while_loop; it excludes --fuse and "
-                "periodic log/checkpoint/dump/check-finite (a non-finite "
-                "state never converges: the residual stays NaN>tol and the "
-                "loop exits at the --iters cap)")
+                "--tol runs inside one while_loop; it excludes --fuse, "
+                "--debug-checks, and periodic log/checkpoint/dump/"
+                "check-finite (a non-finite state never converges: the "
+                "residual stays NaN>tol and the loop exits at the "
+                "--iters cap)")
         t0 = time.perf_counter()
         with _profiled(cfg):
             fields, n_done, res = driver.run_until(
@@ -365,12 +380,20 @@ def run(cfg: RunConfig) -> Tuple:
                 f"--fuse {step_unit}")
         interval //= step_unit
 
+    runner_factory = None
+    if cfg.debug_checks:
+        # checkify cannot thread its error state through shard_map inside a
+        # scan; sharded runs use the carry-based tracker instead (same error).
+        runner_factory = functools.partial(
+            driver.make_checked_runner, use_checkify=not _uses_mesh(cfg))
+
     t0 = time.perf_counter()
     with _profiled(cfg):
         fields = driver.run_simulation(
             st, fields, remaining // step_unit, step_fn=step_fn,
             log_every=interval, callback=callback,
-            start_step=start_step // step_unit)
+            start_step=start_step // step_unit,
+            runner_factory=runner_factory)
         fields = jax.block_until_ready(fields)
     dt = time.perf_counter() - t0
     if cfg.dump_every and cfg.dump_dir:

@@ -38,6 +38,7 @@ class RunConfig:
     ensemble: int = 0  # >0: batch of independent universes via vmap
     fuse: int = 0  # >0: temporal blocking, k steps per HBM pass (experimental)
     check_finite: int = 0  # >0: assert all fields finite every N steps
+    debug_checks: bool = False  # checkify NaN/bounds checks, step-localized
     tol: float = 0.0  # >0: stop when residual < tol (lax.while_loop runner)
     tol_check_every: int = 10  # residual check cadence for --tol
     dump_every: int = 0  # >0: async .npy snapshots of field0 every N steps

@@ -148,6 +148,103 @@ def make_runner(step_fn, n_steps: int, jit: bool = True):
     return run
 
 
+def make_checked_runner(step_fn, n_steps: int, start_step: int = 0,
+                        use_checkify: bool = True):
+    """Debug-mode runner (SURVEY.md §5.2's sanitizer): every step checked.
+
+    The reference has no sanitizers at all — and contains real races and OOB
+    reads (kernel.cu:224 unsynced D2H, §3.4's unsigned-wrap indexing).  JAX
+    makes those structurally impossible; the remaining numerical failure mode
+    is a NaN/Inf blow-up, which ``--check-finite`` only polls at interval
+    boundaries.  This runner instead checks EVERY step inside one jitted
+    ``lax.scan`` and reports the exact step where the state first went
+    non-finite.
+
+    Two instrumentation strategies with identical error semantics:
+
+    * ``use_checkify=True`` (unsharded/ensemble): ``jax.experimental.checkify``
+      — a user check per inexact field whose message carries the absolute
+      step index (checkify keeps the FIRST failure), plus index bounds
+      checks on every gather/scatter.
+    * ``use_checkify=False`` (sharded steps): checkify's error-state
+      threading cannot currently cross ``shard_map`` inside ``lax.scan``
+      (select shape mismatch between the scalar error state and per-device
+      states), so first-failure tracking rides the scan carry as two scalars
+      (step, field) instead — pure jnp, composes with any sharding; index
+      checks are moot on this path (the sharded stepper does no dynamic
+      indexing).
+
+    Returns a runner; call it as ``runner(fields, abs_start_step)`` — raises
+    ``checkify.JaxRuntimeError`` with the step-localized message on failure,
+    else returns the final fields.  No donation: debug mode keeps the input
+    state alive for inspection.
+    """
+    from jax.experimental import checkify
+
+    if use_checkify:
+        def body(carry, idx):
+            new = step_fn(carry)
+            for i, f in enumerate(new):
+                if jnp.issubdtype(f.dtype, jnp.inexact):
+                    checkify.check(
+                        jnp.isfinite(f).all(),
+                        "field %d non-finite after step {step} "
+                        "(NaN/Inf blow-up — check stability parameters)" % i,
+                        step=idx,
+                    )
+            return new, None
+
+        def run(fields: Fields, start) -> Fields:
+            out, _ = lax.scan(
+                body, fields, start + jnp.arange(n_steps, dtype=jnp.int32))
+            return out
+
+        checked = jax.jit(checkify.checkify(
+            run, errors=checkify.user_checks | checkify.index_checks))
+
+        def runner(fields: Fields, start=None) -> Fields:
+            if start is None:
+                start = start_step
+            err, out = checked(fields, jnp.asarray(start, jnp.int32))
+            err.throw()
+            return out
+
+        return runner
+
+    def body(carry, idx):
+        fields, bad_step, bad_field = carry
+        new = step_fn(fields)
+        for i, f in enumerate(new):
+            if not jnp.issubdtype(f.dtype, jnp.inexact):
+                continue
+            newly = (bad_step < 0) & ~jnp.isfinite(f).all()
+            bad_field = jnp.where(newly, i, bad_field)
+            bad_step = jnp.where(newly, idx, bad_step)
+        return (new, bad_step, bad_field), None
+
+    def run(fields: Fields, start):
+        init = (fields, jnp.asarray(-1, jnp.int32), jnp.asarray(-1, jnp.int32))
+        (out, bad_step, bad_field), _ = lax.scan(
+            body, init, start + jnp.arange(n_steps, dtype=jnp.int32))
+        return out, bad_step, bad_field
+
+    jitted = jax.jit(run)
+
+    def runner(fields: Fields, start=None) -> Fields:
+        if start is None:
+            start = start_step
+        out, bad_step, bad_field = jitted(
+            fields, jnp.asarray(start, jnp.int32))
+        step = int(bad_step)
+        if step >= 0:
+            raise checkify.JaxRuntimeError(
+                f"field {int(bad_field)} non-finite after step {step} "
+                "(NaN/Inf blow-up — check stability parameters)")
+        return out
+
+    return runner
+
+
 def run_until(
     step_fn,
     fields: Fields,
@@ -205,6 +302,7 @@ def run_simulation(
     log_every: int = 0,
     callback=None,
     start_step: int = 0,
+    runner_factory=None,
 ) -> Fields:
     """Run ``n_steps``, optionally surfacing state every ``log_every`` steps.
 
@@ -215,11 +313,22 @@ def run_simulation(
     265).  Chunk boundaries align to *absolute* multiples of ``log_every``
     (``start_step`` is where this run resumes from), so a run resumed from a
     non-multiple step keeps logging/checkpointing on the same cadence.
+
+    ``runner_factory(step_fn, n)`` overrides how a chunk is executed; the
+    returned runner is called as ``runner(fields, abs_start_step)`` (the
+    hook through which :func:`make_checked_runner` instruments debug runs —
+    the absolute step makes its error messages name the true failing step
+    across chunks and resumes).
     """
     if step_fn is None:
         step_fn = make_step(stencil, fields[0].shape)
+    if runner_factory is None:
+        def runner_factory(fn, n):
+            r = make_runner(fn, n)
+            return lambda fs, start=0: r(fs)
+
     if not log_every or callback is None:
-        return make_runner(step_fn, n_steps)(fields)
+        return runner_factory(step_fn, n_steps)(fields, start_step)
 
     done = 0
     runners = {}
@@ -228,8 +337,8 @@ def run_simulation(
         boundary = (abs_step // log_every + 1) * log_every
         chunk = min(boundary - abs_step, n_steps - done)
         if chunk not in runners:
-            runners[chunk] = make_runner(step_fn, chunk)
-        fields = runners[chunk](fields)
+            runners[chunk] = runner_factory(step_fn, chunk)
+        fields = runners[chunk](fields, abs_step)
         done += chunk
         callback(done, fields)
     return fields

@@ -65,6 +65,22 @@ def save_checkpoint(path: str, fields, step: int, config: Optional[Dict] = None)
             os.replace(path, old)
         os.replace(tmp, path)
         if old is not None:
+            # Preserve co-located Orbax step_* checkpoints that are NEWER
+            # than this npy save (e.g. a rerun with the default npy backend
+            # into a dir an orbax run wrote): checkpoint_format's
+            # newest-step-wins contract depends on them surviving.  Older
+            # ones are dropped with the rest — exactly-one-checkpoint
+            # retention would otherwise re-preserve a stale orbax dir on
+            # every save forever.
+            for name in os.listdir(old):
+                if name.startswith("step_"):
+                    try:
+                        s = int(name[len("step_"):])
+                    except ValueError:
+                        continue
+                    if s > step:
+                        os.replace(os.path.join(old, name),
+                                   os.path.join(path, name))
             shutil.rmtree(old, ignore_errors=True)
     finally:
         if os.path.isdir(tmp):
@@ -156,10 +172,15 @@ def orbax_save_checkpoint(path: str, fields, step: int,
             ),
             force=True,
         )
-    for old in previous:
-        if old != step:
-            shutil.rmtree(
-                os.path.join(path, f"step_{old:012d}"), ignore_errors=True)
+    # Retention deletion on process 0 only (after the save's completion
+    # barrier): concurrent rmtrees from every process race and can leave
+    # partially-deleted step dirs that _orbax_steps still parses as valid.
+    if jax.process_index() == 0:
+        for old in previous:
+            if old != step:
+                shutil.rmtree(
+                    os.path.join(path, f"step_{old:012d}"),
+                    ignore_errors=True)
 
 
 def _orbax_steps(path: str):

@@ -192,6 +192,31 @@ def test_checkpoint_format_prefers_newest_step(tmp_path):
     assert checkpointing.latest_step(p) == 20
 
 
+def test_npy_save_preserves_newer_orbax_steps(tmp_path):
+    """An npy save must not destroy co-located (possibly NEWER) orbax steps.
+
+    Scenario: an orbax run checkpointed to step 12; a rerun with the default
+    npy backend saves step 6 into the same dir.  The newest-step-wins
+    contract of checkpoint_format requires the orbax step to survive.
+    """
+    import jax.numpy as _jnp
+
+    p = str(tmp_path / "both2")
+    f = (_jnp.zeros((4, 4), _jnp.float32),)
+    checkpointing.orbax_save_checkpoint(p, f, 12)
+    checkpointing.save_checkpoint(p, f, 6)  # older npy into the same dir
+    assert checkpointing.orbax_latest_step(p) == 12
+    assert checkpointing.checkpoint_format(p) == "orbax"
+    _, step, _ = checkpointing.load_any(p)
+    assert step == 12
+    # Once the npy stream pulls AHEAD, the now-stale orbax step must be
+    # dropped (retention: exactly one checkpoint, never re-preserved).
+    checkpointing.save_checkpoint(p, f, 20)
+    assert checkpointing.orbax_latest_step(p) is None
+    assert checkpointing.checkpoint_format(p) == "npy"
+    assert checkpointing.latest_step(p) == 20
+
+
 def test_ensemble_matches_independent_runs():
     """vmapped ensemble == N independent runs with seeds seed..seed+N-1."""
     base = dict(stencil="life", grid=(16, 16), iters=5)

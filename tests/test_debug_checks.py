@@ -1,0 +1,99 @@
+"""--debug-checks: checkify sanitizer with step-localized NaN detection.
+
+SURVEY.md §5.2: the reference ships real races and OOB reads with no
+sanitizer; JAX removes those classes structurally, and the remaining
+numerical failure mode (NaN/Inf blow-up) gets checkify instrumentation here —
+every step checked inside the jitted scan, first failure wins, error message
+names the exact failing step.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import checkify
+
+from mpi_cuda_process_tpu import driver
+from mpi_cuda_process_tpu.cli import run
+from mpi_cuda_process_tpu.config import RunConfig
+
+
+def test_checked_runner_names_first_failing_step():
+    """A synthetic overflow at a known step is reported at THAT step."""
+
+    def step(fields):
+        (u,) = fields
+        return (u * 1e10,)
+
+    runner = driver.make_checked_runner(step, 8)
+    u0 = (jnp.full((4, 4), 1.0, jnp.float32),)
+    # 1e10^k: steps 0..2 give 1e10/1e20/1e30 (finite), step 3 gives 1e40=inf
+    with pytest.raises(checkify.JaxRuntimeError) as ei:
+        runner(u0)
+    assert "non-finite after step 3" in str(ei.value)
+
+
+def test_checked_runner_passes_through_healthy_state():
+    def step(fields):
+        return (fields[0] * 0.5,)
+
+    runner = driver.make_checked_runner(step, 4)
+    out = runner((jnp.full((4, 4), 16.0, jnp.float32),))
+    np.testing.assert_allclose(np.asarray(out[0]), 1.0)
+
+
+def test_checked_runner_uses_absolute_start_step():
+    """Chunk/resume offsets must show up in the reported step index."""
+
+    def step(fields):
+        return (fields[0] * 1e10,)
+
+    runner = driver.make_checked_runner(step, 8)
+    with pytest.raises(checkify.JaxRuntimeError) as ei:
+        runner((jnp.full((2, 2), 1.0, jnp.float32),), start=100)
+    assert "non-finite after step 103" in str(ei.value)
+
+
+def test_cli_debug_checks_localizes_blowup():
+    """An unstable alpha blows up on the first update; the error names step 0."""
+    cfg = RunConfig(stencil="heat2d", grid=(16, 16), iters=10,
+                    debug_checks=True, params={"alpha": 1e38})
+    with pytest.raises(checkify.JaxRuntimeError) as ei:
+        run(cfg)
+    assert "non-finite after step 0" in str(ei.value)
+
+
+def test_cli_debug_checks_healthy_run_matches_plain():
+    base = dict(stencil="heat2d", grid=(16, 16), iters=6, seed=1)
+    plain, _ = run(RunConfig(**base))
+    checked, _ = run(RunConfig(**base, debug_checks=True))
+    np.testing.assert_array_equal(
+        np.asarray(plain[0]), np.asarray(checked[0]))
+
+
+def test_cli_debug_checks_sharded_and_chunked():
+    """debug-checks composes with a mesh AND interval logging (chunked run)."""
+    base = dict(stencil="heat3d", grid=(8, 8, 8), iters=6, seed=2,
+                init="pulse")
+    plain, _ = run(RunConfig(**base))
+    checked, _ = run(RunConfig(**base, mesh=(2, 2, 2), log_every=2,
+                               debug_checks=True))
+    np.testing.assert_allclose(
+        np.asarray(plain[0]), np.asarray(checked[0]), rtol=1e-6)
+
+
+def test_cli_debug_checks_sharded_blowup_localized():
+    """The carry-based tracker (sharded path) names the failing step too."""
+    cfg = RunConfig(stencil="heat2d", grid=(16, 16), iters=10, mesh=(2, 2),
+                    debug_checks=True, params={"alpha": 1e38})
+    with pytest.raises(checkify.JaxRuntimeError) as ei:
+        run(cfg)
+    assert "non-finite after step 0" in str(ei.value)
+
+
+def test_debug_checks_excludes_fuse_and_tol():
+    with pytest.raises(ValueError, match="--debug-checks excludes --fuse"):
+        run(RunConfig(stencil="heat2d", grid=(32, 32), iters=8, fuse=4,
+                      debug_checks=True))
+    with pytest.raises(ValueError, match="--tol"):
+        run(RunConfig(stencil="heat2d", grid=(16, 16), iters=8, tol=1e-3,
+                      debug_checks=True))
