@@ -181,6 +181,18 @@ def orbax_save_checkpoint(path: str, fields, step: int,
                 shutil.rmtree(
                     os.path.join(path, f"step_{old:012d}"),
                     ignore_errors=True)
+        # Mirror of save_checkpoint's cross-backend retention: once the
+        # orbax stream is ahead, a stale co-located npy checkpoint (full
+        # gathered state — 256 GiB at the 4096^3 scale) must not persist.
+        n = _npy_step(path)
+        if n is not None and n < step:
+            try:
+                for name in os.listdir(path):
+                    if name == _META or (name.startswith("field_")
+                                         and name.endswith(".npy")):
+                        os.remove(os.path.join(path, name))
+            except OSError:
+                pass
 
 
 def _orbax_steps(path: str):

@@ -215,6 +215,11 @@ def test_npy_save_preserves_newer_orbax_steps(tmp_path):
     assert checkpointing.orbax_latest_step(p) is None
     assert checkpointing.checkpoint_format(p) == "npy"
     assert checkpointing.latest_step(p) == 20
+    # ...and symmetrically: an orbax save past the npy step drops the npy.
+    checkpointing.orbax_save_checkpoint(p, f, 30)
+    assert checkpointing._npy_step(p) is None
+    assert checkpointing.checkpoint_format(p) == "orbax"
+    assert checkpointing.latest_step(p) == 30
 
 
 def test_ensemble_matches_independent_runs():
