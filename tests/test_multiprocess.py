@@ -57,6 +57,61 @@ def _free_port():
     return port
 
 
+# Worker for the multi-host fault-injection test (SURVEY.md §5.3): runs a
+# 2-process sharded Life simulation in 10-step chunks, orbax-checkpointing
+# after every chunk (each process writes its own shards).  With --resume it
+# first restores the latest step onto this pair's sharding.  Stops at the
+# step given by sys.argv[3] (0 = run "forever", i.e. until killed).
+_FAULT_WORKER = r"""
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+rank = int(sys.argv[1]); port = sys.argv[2]
+horizon = int(sys.argv[3]); resume = sys.argv[4] == "resume"
+
+from mpi_cuda_process_tpu.parallel.mesh import bootstrap_distributed, make_mesh
+from mpi_cuda_process_tpu.parallel.stepper import grid_partition_spec
+from mpi_cuda_process_tpu import make_sharded_step, make_stencil
+from mpi_cuda_process_tpu.driver import make_runner
+from mpi_cuda_process_tpu.utils.init import init_state_sharded
+from mpi_cuda_process_tpu.utils import checkpointing
+
+ok = bootstrap_distributed(coordinator_address=f"localhost:{{port}}",
+                           num_processes=2, process_id=rank,
+                           init_timeout_s=120)
+assert ok and jax.process_count() == 2
+
+st = make_stencil("life")
+grid = (16, 16)
+mesh = make_mesh((2,))
+step = make_sharded_step(st, mesh, grid)
+run10 = make_runner(step, 10)
+
+done = 0
+if resume:
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, grid_partition_spec(st.ndim, mesh))
+    targets = tuple(jax.ShapeDtypeStruct(grid, st.dtype, sharding=sharding)
+                    for _ in range(st.num_fields))
+    fields, done, _ = checkpointing.orbax_load_checkpoint(
+        {ck!r}, target_fields=targets)
+    print(f"RESUMED rank={{rank}} step={{done}}", flush=True)
+else:
+    fields = init_state_sharded(st, grid, mesh, seed=7, density=0.3,
+                                kind="random")
+
+while horizon == 0 or done < horizon:
+    fields = run10(fields)
+    done += 10
+    checkpointing.orbax_save_checkpoint({ck!r}, fields, done)
+
+total = int(jax.numpy.sum(fields[0]))
+print(f"RESULT rank={{rank}} step={{done}} total={{total}}", flush=True)
+"""
+
+
 @pytest.mark.slow
 def test_two_process_distributed_matches_single():
     port = _free_port()
