@@ -1,0 +1,148 @@
+"""Single-chip measurement campaign for the BASELINE.md perf table.
+
+Runs the full config matrix on the real TPU and appends each result to
+``benchmarks/results_r02.json`` IMMEDIATELY after it is measured, so a
+wedged tunnel mid-campaign loses only the in-flight config.
+
+Timing method (same as bench.py): scan N steps and 4N steps, take the
+difference / 3N — cancels the ~66 ms tunnel dispatch + readback overhead
+(docs/STATE.md "Infra gotchas").
+
+Usage:  python benchmarks/measure.py [--out FILE] [--only NAME ...]
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from mpi_cuda_process_tpu import init_state, make_step, make_stencil
+from mpi_cuda_process_tpu.driver import make_runner
+from mpi_cuda_process_tpu.ops.pallas import has_pallas_kernel, make_pallas_compute
+
+
+def _fence(fields) -> float:
+    # Actual scalar read: the only reliable completion fence on the tunneled
+    # backend (block_until_ready can return early — docs/STATE.md).
+    return float(jnp.sum(fields[0].astype(jnp.float32)))
+
+
+def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
+            params=None):
+    kw = dict(params or {})
+    if dtype is not None:
+        kw["dtype"] = dtype
+    st = make_stencil(name, **kw)
+    compute_fn = None
+    if compute == "pallas":
+        if not has_pallas_kernel(name):
+            raise ValueError(f"no pallas kernel for {name}")
+        compute_fn = make_pallas_compute(st, interpret=False)
+    step = make_step(st, grid, compute_fn=compute_fn)
+    mk = lambda: init_state(st, grid, kind="auto")  # noqa: E731
+    run_a = make_runner(step, steps)
+    run_b = make_runner(step, 4 * steps)
+    _fence(run_a(mk()))  # compile + warm
+    _fence(run_b(mk()))
+
+    def best(run):
+        b = math.inf
+        for _ in range(reps):
+            f = mk()
+            _fence(f)
+            t0 = time.perf_counter()
+            _fence(run(f))
+            b = min(b, time.perf_counter() - t0)
+        return b
+
+    t_a, t_b = best(run_a), best(run_b)
+    per_step = max((t_b - t_a) / (3 * steps), 1e-9)
+    mcells = math.prod(grid) / per_step / 1e6
+    return {"ms_per_step": round(per_step * 1e3, 4),
+            "mcells_per_s": round(mcells, 1)}
+
+
+# (label, stencil, grid, steps, dtype, compute)
+CONFIGS = [
+    # BASELINE.json config 1 + 2 refresh
+    ("heat2d_512_f32", "heat2d", (512, 512), 400, "float32", "jnp"),
+    ("heat3d_256_f32", "heat3d", (256, 256, 256), 100, "float32", "jnp"),
+    # bf16 halves HBM bytes (STATE.md open avenue 2)
+    ("heat3d_256_bf16", "heat3d", (256, 256, 256), 100, "bfloat16", "jnp"),
+    # larger grid: bandwidth bound binding (open avenue 3)
+    ("heat3d_512_f32", "heat3d", (512, 512, 512), 30, "float32", "jnp"),
+    ("heat3d_512_bf16", "heat3d", (512, 512, 512), 30, "bfloat16", "jnp"),
+    # the _PALLAS_WINS question (open avenue 1 / VERDICT item 3)
+    ("heat3d27_256_f32_jnp", "heat3d27", (256, 256, 256), 50, "float32", "jnp"),
+    ("heat3d27_256_f32_pallas", "heat3d27", (256, 256, 256), 50, "float32",
+     "pallas"),
+    ("heat3d4th_256_f32_jnp", "heat3d4th", (256, 256, 256), 50, "float32",
+     "jnp"),
+    ("heat3d4th_256_f32_pallas", "heat3d4th", (256, 256, 256), 50, "float32",
+     "pallas"),
+    ("heat3d27_256_bf16_jnp", "heat3d27", (256, 256, 256), 50, "bfloat16",
+     "jnp"),
+    ("heat3d27_256_bf16_pallas", "heat3d27", (256, 256, 256), 50, "bfloat16",
+     "pallas"),
+    # two-field wave (BASELINE config 5 family), fp32 vs bf16 (VERDICT item 9)
+    ("wave3d_256_f32", "wave3d", (256, 256, 256), 50, "float32", "jnp"),
+    ("wave3d_256_bf16", "wave3d", (256, 256, 256), 50, "bfloat16", "jnp"),
+    ("wave3d_512_bf16", "wave3d", (512, 512, 512), 20, "bfloat16", "jnp"),
+    # int32 GoL throughput (bit-exact family)
+    ("life_2048_i32", "life", (2048, 2048), 200, None, "jnp"),
+    # pallas single-chip 7-point for completeness (M1 kernel)
+    ("heat3d_256_f32_pallas", "heat3d", (256, 256, 256), 100, "float32",
+     "pallas"),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results_r02.json"))
+    ap.add_argument("--only", nargs="*", default=None)
+    args = ap.parse_args()
+
+    results = {}
+    if os.path.exists(args.out):
+        with open(args.out) as fh:
+            results = json.load(fh)
+
+    backend = jax.default_backend()
+    print(f"[measure] backend={backend} devices={jax.devices()}",
+          file=sys.stderr)
+
+    for label, name, grid, steps, dtype, compute in CONFIGS:
+        if args.only and label not in args.only:
+            continue
+        if label in results and not args.only:
+            print(f"[measure] {label}: cached, skip", file=sys.stderr)
+            continue
+        t0 = time.time()
+        try:
+            rec = measure(name, grid, steps, dtype=dtype, compute=compute)
+        except Exception as e:  # noqa: BLE001 — record & continue campaign
+            rec = {"error": f"{type(e).__name__}: {e}"[:500]}
+        rec.update({"stencil": name, "grid": list(grid), "dtype": dtype,
+                    "compute": compute, "backend": backend,
+                    "wall_s": round(time.time() - t0, 1),
+                    "measured_at": time.time()})
+        results[label] = rec
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(results, fh, indent=1, sort_keys=True)
+        os.replace(tmp, args.out)
+        print(f"[measure] {label}: {rec}", file=sys.stderr)
+
+    print(json.dumps(results, indent=1, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()
