@@ -127,18 +127,29 @@ def _time_run(run, mk_state, reps) -> float:
     return best
 
 
-def bench_stencil(name, grid, params, timed_steps, reps=3):
+def bench_stencil(name, grid, params, timed_steps, reps=3, fuse=0):
     """Per-step throughput with fixed dispatch/readback overhead removed.
 
     Times scans of N and 4N steps; the difference isolates pure step time
-    (the ~66 ms tunnel round-trip and the readback cancel out).
+    (the ~66 ms tunnel round-trip and the readback cancel out).  With
+    ``fuse=k`` the step is the temporal-blocking fused Pallas kernel (k
+    real steps per call — the CLI's ``auto`` path on TPU); falls back to
+    the jnp step if the fused kernel cannot be built.
     """
     from mpi_cuda_process_tpu import init_state, make_step, make_stencil
     from mpi_cuda_process_tpu.driver import make_runner
 
     st = make_stencil(name, **params)
     mk_state = lambda: init_state(st, grid, kind="auto")  # noqa: E731
-    step = make_step(st, grid)
+    step_unit, step, compute = 1, None, "jnp"
+    if fuse > 1:
+        from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
+
+        step = make_fused_step(st, grid, fuse)  # interpret off-TPU
+        if step is not None:
+            step_unit, compute = fuse, f"pallas_fused_k{fuse}"
+    if step is None:
+        step = make_step(st, grid)
     run_a = make_runner(step, timed_steps)
     run_b = make_runner(step, 4 * timed_steps)
     _fence(run_a(mk_state()))  # compile + warm
@@ -147,21 +158,37 @@ def bench_stencil(name, grid, params, timed_steps, reps=3):
     _progress()
     t_a = _time_run(run_a, mk_state, reps)
     t_b = _time_run(run_b, mk_state, reps)
-    per_step = max((t_b - t_a) / (3 * timed_steps), 1e-9)
+    per_step = max((t_b - t_a) / (3 * timed_steps * step_unit), 1e-9)
     cells = math.prod(grid)
-    return cells / per_step / 1e6, per_step
+    return cells / per_step / 1e6, per_step, compute
+
+
+def _bench_safe(name, grid, steps, fuse):
+    """Measure, falling back to the jnp path on a fused-Pallas failure."""
+    try:
+        return bench_stencil(name, grid, {}, steps, fuse=fuse)
+    except Exception as e:  # noqa: BLE001 — bench must emit, not crash
+        if fuse <= 1:
+            raise  # the failing attempt WAS the jnp path; nothing to fall to
+        print(f"[bench] fused path failed ({type(e).__name__}); "
+              "re-measuring on jnp", file=sys.stderr)
+        return bench_stencil(name, grid, {}, steps, fuse=0)
 
 
 def main():
     backend = jax.default_backend()
     if backend == "cpu":
-        grid, steps = (128, 128, 128), 10
+        grid, steps, fuse = (128, 128, 128), 10, 0
+        grid_lg, steps_lg = None, 0
     else:
-        grid, steps = (256, 256, 256), 100
-    mcells, per_step = bench_stencil("heat3d", grid, {}, steps)
+        grid, steps, fuse = (256, 256, 256), 50, 4
+        # the honest large-grid number: the regime where XLA's fusion
+        # collapses (round-2 verdict) and the north star (4096^3) lives
+        grid_lg, steps_lg = (512, 512, 512), 15
+    mcells, per_step, compute = _bench_safe("heat3d", grid, steps, fuse)
     print(
-        f"[bench] backend={backend} heat3d {'x'.join(map(str, grid))}: "
-        f"{per_step*1e3:.3f} ms/step ({mcells:.0f} Mcells/s)",
+        f"[bench] backend={backend} heat3d {'x'.join(map(str, grid))} "
+        f"[{compute}]: {per_step*1e3:.3f} ms/step ({mcells:.0f} Mcells/s)",
         file=sys.stderr,
     )
     rec = {
@@ -169,7 +196,20 @@ def main():
         "value": round(mcells, 1),
         "unit": "Mcells/s",
         "vs_baseline": round(mcells / BASELINE_MCELLS, 4),
+        "compute": compute,
     }
+    if grid_lg is not None:
+        mc_lg, ps_lg, compute_lg = _bench_safe(
+            "heat3d", grid_lg, steps_lg, fuse)
+        print(
+            f"[bench] backend={backend} heat3d "
+            f"{'x'.join(map(str, grid_lg))} [{compute_lg}]: "
+            f"{ps_lg*1e3:.3f} ms/step ({mc_lg:.0f} Mcells/s)",
+            file=sys.stderr,
+        )
+        rec["value_512cubed"] = round(mc_lg, 1)
+        rec["vs_baseline_512cubed"] = round(mc_lg / BASELINE_MCELLS, 4)
+        rec["compute_512cubed"] = compute_lg
     if backend == "tpu":
         try:
             tmp = _CACHE + ".tmp"

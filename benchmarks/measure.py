@@ -1,8 +1,10 @@
 """Single-chip measurement campaign for the BASELINE.md perf table.
 
 Runs the full config matrix on the real TPU and appends each result to
-``benchmarks/results_r02.json`` IMMEDIATELY after it is measured, so a
-wedged tunnel mid-campaign loses only the in-flight config.
+``benchmarks/results_r03.json`` IMMEDIATELY after it is measured, so a
+wedged tunnel mid-campaign loses only the in-flight config.  Errored
+configs are retried on the next invocation (only successful records are
+skip-cached), so a transient tunnel failure heals on re-run.
 
 Timing method (same as bench.py): scan N steps and 4N steps, take the
 difference / 3N — cancels the ~66 ms tunnel dispatch + readback overhead
@@ -36,16 +38,32 @@ def _fence(fields) -> float:
 
 def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
             params=None):
+    """compute: jnp | pallas (compute_fn inside the pad step) |
+    raw (whole-step raw kernel) | fusedK (temporal blocking, K steps/pass).
+    """
     kw = dict(params or {})
     if dtype is not None:
         kw["dtype"] = dtype
     st = make_stencil(name, **kw)
-    compute_fn = None
-    if compute == "pallas":
-        if not has_pallas_kernel(name):
-            raise ValueError(f"no pallas kernel for {name}")
-        compute_fn = make_pallas_compute(st, interpret=False)
-    step = make_step(st, grid, compute_fn=compute_fn)
+    step_unit = 1
+    if compute == "raw":
+        from mpi_cuda_process_tpu.ops.pallas.rawstep import make_raw_step
+        step = make_raw_step(st, grid, interpret=False)
+        if step is None:
+            raise ValueError(f"no raw step for {name} on {grid}")
+    elif compute.startswith("fused"):
+        from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
+        step_unit = int(compute[len("fused"):])
+        step = make_fused_step(st, grid, step_unit, interpret=False)
+        if step is None:
+            raise ValueError(f"untileable fused k={step_unit} for {grid}")
+    else:
+        compute_fn = None
+        if compute == "pallas":
+            if not has_pallas_kernel(name):
+                raise ValueError(f"no pallas kernel for {name}")
+            compute_fn = make_pallas_compute(st, interpret=False)
+        step = make_step(st, grid, compute_fn=compute_fn)
     mk = lambda: init_state(st, grid, kind="auto")  # noqa: E731
     run_a = make_runner(step, steps)
     run_b = make_runner(step, 4 * steps)
@@ -63,7 +81,13 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
         return b
 
     t_a, t_b = best(run_a), best(run_b)
-    per_step = max((t_b - t_a) / (3 * steps), 1e-9)
+    if t_b - t_a <= 0:
+        # Timing noise swamped the signal (t(4N) <= t(N)): report, don't
+        # fabricate a plausible-looking Mcells/s from a clamped epsilon.
+        return {"error": f"non-positive step time: t_a={t_a:.4f}s "
+                         f"t_b={t_b:.4f}s (timing noise; rerun)",
+                "suspect": True}
+    per_step = (t_b - t_a) / (3 * steps * step_unit)
     mcells = math.prod(grid) / per_step / 1e6
     return {"ms_per_step": round(per_step * 1e3, 4),
             "mcells_per_s": round(mcells, 1)}
@@ -76,72 +100,148 @@ CONFIGS = [
     ("heat3d_256_f32", "heat3d", (256, 256, 256), 100, "float32", "jnp"),
     # bf16 halves HBM bytes (STATE.md open avenue 2)
     ("heat3d_256_bf16", "heat3d", (256, 256, 256), 100, "bfloat16", "jnp"),
-    # larger grid: bandwidth bound binding (open avenue 3)
+    # larger grid: the round-2 XLA fusion cliff regime
     ("heat3d_512_f32", "heat3d", (512, 512, 512), 30, "float32", "jnp"),
     ("heat3d_512_bf16", "heat3d", (512, 512, 512), 30, "bfloat16", "jnp"),
-    # the _PALLAS_WINS question (open avenue 1 / VERDICT item 3)
+    # whole-step raw Pallas kernels (round 3; ops/pallas/rawstep.py)
+    ("heat3d_256_f32_raw", "heat3d", (256, 256, 256), 100, "float32", "raw"),
+    ("heat3d_512_f32_raw", "heat3d", (512, 512, 512), 30, "float32", "raw"),
+    ("heat3d27_256_f32_raw", "heat3d27", (256, 256, 256), 50, "float32",
+     "raw"),
+    ("heat3d27_512_f32_raw", "heat3d27", (512, 512, 512), 20, "float32",
+     "raw"),
+    ("heat3d4th_256_f32_raw", "heat3d4th", (256, 256, 256), 50, "float32",
+     "raw"),
+    ("wave3d_256_f32_raw", "wave3d", (256, 256, 256), 50, "float32", "raw"),
+    ("wave3d_512_f32_raw", "wave3d", (512, 512, 512), 20, "float32", "raw"),
+    # temporal blocking: k real steps per HBM pass (ops/pallas/fused.py);
+    # the CLI's auto path for heat3d
+    ("heat3d_256_f32_fused4", "heat3d", (256, 256, 256), 25, "float32",
+     "fused4"),
+    ("heat3d_512_f32_fused4", "heat3d", (512, 512, 512), 10, "float32",
+     "fused4"),
+    ("heat3d_512_bf16_fused4", "heat3d", (512, 512, 512), 10, "bfloat16",
+     "fused4"),
+    # 1024^3 bf16: 2.1 GiB/buffer — the largest-grid single-chip point
+    # (VERDICT item 3); jnp vs raw vs fused
+    ("heat3d_1024_bf16", "heat3d", (1024, 1024, 1024), 8, "bfloat16", "jnp"),
+    ("heat3d_1024_bf16_raw", "heat3d", (1024, 1024, 1024), 8, "bfloat16",
+     "raw"),
+    ("heat3d_1024_bf16_fused4", "heat3d", (1024, 1024, 1024), 4, "bfloat16",
+     "fused4"),
+    # jnp references for the 27-point / 13-point / wave families
     ("heat3d27_256_f32_jnp", "heat3d27", (256, 256, 256), 50, "float32", "jnp"),
-    ("heat3d27_256_f32_pallas", "heat3d27", (256, 256, 256), 50, "float32",
-     "pallas"),
     ("heat3d4th_256_f32_jnp", "heat3d4th", (256, 256, 256), 50, "float32",
      "jnp"),
-    ("heat3d4th_256_f32_pallas", "heat3d4th", (256, 256, 256), 50, "float32",
-     "pallas"),
     ("heat3d27_256_bf16_jnp", "heat3d27", (256, 256, 256), 50, "bfloat16",
      "jnp"),
-    ("heat3d27_256_bf16_pallas", "heat3d27", (256, 256, 256), 50, "bfloat16",
-     "pallas"),
-    # two-field wave (BASELINE config 5 family), fp32 vs bf16 (VERDICT item 9)
+    # two-field wave (BASELINE config 5 family), fp32 vs bf16
     ("wave3d_256_f32", "wave3d", (256, 256, 256), 50, "float32", "jnp"),
     ("wave3d_256_bf16", "wave3d", (256, 256, 256), 50, "bfloat16", "jnp"),
     ("wave3d_512_bf16", "wave3d", (512, 512, 512), 20, "bfloat16", "jnp"),
     # int32 GoL throughput (bit-exact family)
     ("life_2048_i32", "life", (2048, 2048), 200, None, "jnp"),
-    # pallas single-chip 7-point for completeness (M1 kernel)
+    # compute_fn z-chunk kernel inside the pad step (M1 kernel, for the
+    # record: measured below both jnp and raw — kept as the regression probe
+    # for the pad-based pallas integration)
     ("heat3d_256_f32_pallas", "heat3d", (256, 256, 256), 100, "float32",
      "pallas"),
 ]
 
 
+def _measure_one(out_path, label, name, grid, steps, dtype, compute):
+    """Measure one config and merge its record into ``out_path``."""
+    backend = jax.default_backend()
+    t0 = time.time()
+    try:
+        rec = measure(name, grid, steps, dtype=dtype, compute=compute)
+    except Exception as e:  # noqa: BLE001 — record & continue campaign
+        rec = {"error": f"{type(e).__name__}: {e}"[:500]}
+    rec.update({"stencil": name, "grid": list(grid), "dtype": dtype,
+                "compute": compute, "backend": backend,
+                "wall_s": round(time.time() - t0, 1),
+                "measured_at": time.time()})
+    results = {}
+    if os.path.exists(out_path):
+        with open(out_path) as fh:
+            results = json.load(fh)
+    results[label] = rec
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(results, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, out_path)
+    print(f"[measure] {label}: {rec}", file=sys.stderr)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "results_r02.json"))
+        os.path.dirname(os.path.abspath(__file__)), "results_r03.json"))
     ap.add_argument("--only", nargs="*", default=None)
+    ap.add_argument("--in-process", action="store_true",
+                    help="measure in this process instead of one subprocess "
+                         "per config (an OOM then poisons later configs)")
     args = ap.parse_args()
+
+    known = {label for label, *_ in CONFIGS}
+    unknown = set(args.only or ()) - known
+    if unknown:
+        ap.error(f"unknown --only labels {sorted(unknown)}; "
+                 f"choose from {sorted(known)}")
 
     results = {}
     if os.path.exists(args.out):
         with open(args.out) as fh:
             results = json.load(fh)
 
-    backend = jax.default_backend()
-    print(f"[measure] backend={backend} devices={jax.devices()}",
-          file=sys.stderr)
-
+    consecutive_timeouts = 0
     for label, name, grid, steps, dtype, compute in CONFIGS:
         if args.only and label not in args.only:
             continue
-        if label in results and not args.only:
+        cached = results.get(label)
+        if cached and "error" not in cached and not args.only:
             print(f"[measure] {label}: cached, skip", file=sys.stderr)
             continue
-        t0 = time.time()
-        try:
-            rec = measure(name, grid, steps, dtype=dtype, compute=compute)
-        except Exception as e:  # noqa: BLE001 — record & continue campaign
-            rec = {"error": f"{type(e).__name__}: {e}"[:500]}
-        rec.update({"stencil": name, "grid": list(grid), "dtype": dtype,
-                    "compute": compute, "backend": backend,
-                    "wall_s": round(time.time() - t0, 1),
-                    "measured_at": time.time()})
-        results[label] = rec
-        tmp = args.out + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(results, fh, indent=1, sort_keys=True)
-        os.replace(tmp, args.out)
-        print(f"[measure] {label}: {rec}", file=sys.stderr)
+        if args.in_process or args.only:
+            _measure_one(args.out, label, name, grid, steps, dtype, compute)
+        else:
+            # Subprocess isolation: a RESOURCE_EXHAUSTED on one config must
+            # not leave the TPU arena poisoned for every config after it
+            # (observed in the round-3 campaign: a 1024^3 OOM turned the
+            # rest of the matrix into cascade failures).
+            import subprocess
 
-    print(json.dumps(results, indent=1, sort_keys=True))
+            try:
+                p = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--only", label, "--out", os.path.abspath(args.out)],
+                    cwd=os.path.dirname(
+                        os.path.dirname(os.path.abspath(__file__))),
+                    timeout=1200,
+                )
+                if p.returncode != 0:
+                    print(f"[measure] {label}: subprocess rc={p.returncode}",
+                          file=sys.stderr)
+                consecutive_timeouts = 0
+            except subprocess.TimeoutExpired:
+                # a wedged config must cost only itself, not the campaign
+                print(f"[measure] {label}: subprocess timeout (1200s), "
+                      "skipping", file=sys.stderr)
+                consecutive_timeouts += 1
+                if consecutive_timeouts >= 2:
+                    # Two configs in a row hanging = the tunnel itself is
+                    # wedged (recovery is passive and takes hours —
+                    # docs/STATE.md); paying 1200s per remaining config
+                    # would burn the whole campaign for nothing.
+                    print("[measure] 2 consecutive timeouts — tunnel looks "
+                          "wedged, aborting campaign (rerun to resume)",
+                          file=sys.stderr)
+                    break
+
+    if not args.only and os.path.exists(args.out):
+        with open(args.out) as fh:
+            print(fh.read())
 
 
 if __name__ == "__main__":

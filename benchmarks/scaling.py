@@ -76,7 +76,7 @@ def _time_run(run, fields, reps: int) -> float:
     return best
 
 
-def bench_config(st, mesh_shape, global_shape, steps, reps=3):
+def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False):
     import jax
 
     from mpi_cuda_process_tpu import (
@@ -87,7 +87,7 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3):
     n_dev = math.prod(mesh_shape)
     if n_dev > 1:
         mesh = make_mesh(mesh_shape)
-        step = make_sharded_step(st, mesh, global_shape)
+        step = make_sharded_step(st, mesh, global_shape, overlap=overlap)
     else:
         step = make_step(st, global_shape)
     fields = init_state(st, global_shape, kind="auto")
@@ -164,6 +164,10 @@ def main(argv=None) -> int:
     p.add_argument("--reps", type=int, default=3)
     p.add_argument("--virtual", type=int, default=0,
                    help="force N virtual CPU devices (0 = real devices)")
+    p.add_argument("--overlap", action="store_true",
+                   help="use the explicit interior/boundary overlap stepper "
+                        "(weak/strong modes) — compare against the default "
+                        "XLA-scheduled exchange")
     a = p.parse_args(argv)
 
     jax = _setup_devices(a.virtual)
@@ -205,7 +209,8 @@ def main(argv=None) -> int:
             if any(g % m for g, m in zip(global_shape, mesh_shape)):
                 continue
         mcells, per_step = bench_config(
-            st, mesh_shape, global_shape, a.steps, a.reps)
+            st, mesh_shape, global_shape, a.steps, a.reps,
+            overlap=a.overlap)
         per_dev = mcells / n_dev
         if base is None:
             base = per_dev if a.mode == "weak" else mcells
@@ -214,6 +219,7 @@ def main(argv=None) -> int:
         rows.append((mesh_shape, global_shape, mcells, per_dev, eff))
         rec = {
             "mode": a.mode, "stencil": a.stencil,
+            "overlap": a.overlap,
             "mesh": list(mesh_shape), "grid": list(global_shape),
             "mcells_per_s": round(mcells, 1),
             "mcells_per_s_per_device": round(per_dev, 1),

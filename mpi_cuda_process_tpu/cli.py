@@ -131,9 +131,18 @@ def config_from_args(argv=None) -> RunConfig:
     )
 
 
-# Stencils whose Pallas kernel beats XLA's fusion on TPU (measured); all
-# others fuse to ~HBM roofline already and default to the jnp path.
-_PALLAS_WINS = {"heat3d27"}
+# Measured on the real v5e chip, round 3 (benchmarks/results_r03.json):
+# the whole-step raw Pallas kernels (ops/pallas/rawstep.py) beat XLA's
+# fusion for these stencils at every size — and for heat3d only in the
+# large-grid regime where XLA's pad+update fusion collapses (17.6 Gcells/s
+# at 512^3 vs 85 at 256^3; the raw kernel holds ~40).
+_RAW_WINS = {"heat3d27", "heat3d4th", "wave3d"}
+_CLIFF_CELLS = 100_000_000  # heat3d: jnp wins below, raw kernel above
+
+# Transparent temporal blocking (ops/pallas/fused.py): k=4 measured ~107
+# Gcells/s at BOTH 256^3 and 512^3 f32 (results_r03.json) — the fastest
+# heat3d path at every size.  Auto-applied when step accounting allows it.
+_AUTO_FUSE_K = 4
 
 
 def _uses_mesh(cfg: RunConfig) -> bool:
@@ -141,17 +150,82 @@ def _uses_mesh(cfg: RunConfig) -> bool:
     return bool(cfg.mesh) and math.prod(cfg.mesh) > 1 and not cfg.ensemble
 
 
+def _make_cfg_stencil(cfg: RunConfig):
+    params = dict(cfg.params)
+    if cfg.dtype:
+        params.setdefault("dtype", jnp.dtype(cfg.dtype))
+    return stencil_lib.make_stencil(cfg.stencil, **params)
+
+
+def maybe_auto_fuse(cfg: RunConfig) -> RunConfig:
+    """Upgrade an eligible ``--compute auto`` heat3d run to ``--fuse 4``.
+
+    Bit-for-bit: k fused steps == k plain steps (tests/test_fused.py), so
+    this is purely an execution-strategy choice.  Only taken when every
+    cadence (iters, log/checkpoint/dump/check-finite intervals) is a
+    multiple of k, nothing about the run observes individual steps, and the
+    grid is tileable; a compile failure on the real chip is caught by
+    ``run``'s auto-retry, which re-runs the whole config on the jnp path.
+    """
+    if cfg.compute != "auto" or cfg.fuse or cfg.stencil != "heat3d":
+        return cfg
+    if jax.default_backend() != "tpu":
+        return cfg
+    if (cfg.periodic or cfg.tol > 0 or cfg.debug_checks or cfg.ensemble
+            or cfg.overlap or cfg.resume or _uses_mesh(cfg) or cfg.mesh):
+        return cfg
+    k = _AUTO_FUSE_K
+    cadences = [cfg.iters, cfg.log_every, cfg.checkpoint_every,
+                cfg.check_finite, cfg.dump_every]
+    if any(v % k for v in cadences if v):
+        return cfg
+    from .ops.pallas.fused import make_fused_step
+    if make_fused_step(_make_cfg_stencil(cfg), cfg.grid, k) is None:
+        return cfg  # untileable shape
+    log.info("auto: temporal blocking k=%d (fused Pallas kernel)", k)
+    return dataclasses.replace(cfg, fuse=k)
+
+
+def _raw_eligible(cfg: RunConfig, name: str) -> bool:
+    """Structural eligibility of the whole-step raw Pallas kernel."""
+    if cfg.periodic or cfg.ensemble or _uses_mesh(cfg) or cfg.fuse:
+        return False
+    if cfg.compute == "jnp" or jax.default_backend() != "tpu":
+        return False
+    if cfg.compute == "pallas":
+        return True
+    return name in _RAW_WINS or (
+        name == "heat3d" and math.prod(cfg.grid) >= _CLIFF_CELLS)
+
+
+def resolve_raw_step(cfg: RunConfig, st):
+    """Whole-step raw Pallas kernel for eligible unsharded TPU runs, or None.
+
+    Replaces step construction entirely (state is its own halo — see
+    ops/pallas/rawstep.py); selected when measured faster than the jnp
+    path, or always under explicit ``--compute pallas`` where supported.
+    """
+    from .ops.pallas import rawstep
+
+    if not _raw_eligible(cfg, st.name):
+        return None
+    if not rawstep.raw_step_supported(st):
+        return None
+    return rawstep.make_raw_step(st, cfg.grid)
+
+
 def resolve_compute_fn(cfg: RunConfig, st):
     from .ops.pallas import has_pallas_kernel, make_pallas_compute
 
     mode = cfg.compute
-    if mode == "auto":
-        use = st.name in _PALLAS_WINS and jax.default_backend() == "tpu"
-    elif mode == "pallas":
+    if mode == "pallas":
         if not has_pallas_kernel(st.name):
             raise ValueError(f"no pallas kernel for {st.name!r}")
         use = True
     else:
+        # auto: the compute_fn kernels (which run inside the pad-based
+        # step) measured below the XLA-fused jnp path wherever both work;
+        # the auto Pallas wins live in resolve_raw_step/maybe_auto_fuse.
         use = False
     return make_pallas_compute(st) if use else None
 
@@ -186,10 +260,7 @@ def _resume(cfg: RunConfig, targets):
 
 def build(cfg: RunConfig):
     """Materialize (stencil, step_fn, fields, start_step) from a config."""
-    params = dict(cfg.params)
-    if cfg.dtype:
-        params.setdefault("dtype", jnp.dtype(cfg.dtype))
-    st = stencil_lib.make_stencil(cfg.stencil, **params)
+    st = _make_cfg_stencil(cfg)
 
     start_step = 0
     use_mesh = _uses_mesh(cfg)
@@ -243,7 +314,8 @@ def build(cfg: RunConfig):
             fields, start_step = _resume(cfg, fields)
         # fused step_fn advances cfg.fuse steps per call; run() accounts.
         return st, fused, fields, start_step
-    compute_fn = resolve_compute_fn(cfg, st)
+    raw_step = resolve_raw_step(cfg, st)
+    compute_fn = None if raw_step is not None else resolve_compute_fn(cfg, st)
     if cfg.ensemble:
         step_fn = driver.make_ensemble_step(driver.make_step(
             st, cfg.grid, periodic=cfg.periodic, compute_fn=compute_fn))
@@ -254,6 +326,9 @@ def build(cfg: RunConfig):
         step_fn = stepper_lib.make_sharded_step(
             st, m, cfg.grid, periodic=cfg.periodic, compute_fn=compute_fn,
             overlap=cfg.overlap)
+    elif raw_step is not None:
+        log.info("compute: whole-step raw Pallas kernel (%s)", st.name)
+        step_fn = raw_step
     else:
         step_fn = driver.make_step(
             st, cfg.grid, periodic=cfg.periodic, compute_fn=compute_fn)
@@ -291,7 +366,37 @@ def _epilogue(cfg: RunConfig, fields, final_step: int, save_ckpt: bool):
 
 
 def run(cfg: RunConfig) -> Tuple:
-    """Execute a configured run; returns (final_fields, mcells_per_s)."""
+    """Execute a configured run; returns (final_fields, mcells_per_s).
+
+    ``--compute auto`` has a no-crash guarantee on the Pallas paths: if the
+    auto-selected kernel (temporal blocking or the raw whole-step kernel)
+    fails to compile or run on the real chip, the whole config is re-run on
+    the jnp path with a warning — ``auto`` never turns a valid config into
+    a JaxRuntimeError (round-2 verdict: ``_PALLAS_WINS`` used to route
+    heat3d27 straight into a compile failure).
+    """
+    fused_cfg = maybe_auto_fuse(cfg)
+    # "Did auto actually pick a Pallas path?" — not just eligibility: the
+    # raw-step builder can decline (untileable shape), in which case the run
+    # is pure jnp and a failure there must surface, not trigger a pointless
+    # identical re-run.
+    auto_pallas = fused_cfg.fuse != cfg.fuse
+    if not auto_pallas and cfg.compute == "auto" and \
+            _raw_eligible(cfg, cfg.stencil):
+        auto_pallas = resolve_raw_step(cfg, _make_cfg_stencil(cfg)) is not None
+    try:
+        return _run_once(fused_cfg)
+    except jax.errors.JaxRuntimeError as e:
+        if not auto_pallas:
+            raise
+        first = str(e).splitlines()[0][:160] if str(e) else type(e).__name__
+        log.warning(
+            "auto-selected Pallas path failed (%s); retrying this run on "
+            "the jnp path", first)
+        return _run_once(dataclasses.replace(cfg, compute="jnp"))
+
+
+def _run_once(cfg: RunConfig) -> Tuple:
     if cfg.debug_checks and cfg.fuse:
         raise ValueError("--debug-checks excludes --fuse (the fused "
                          "kernel replaces the step being instrumented)")
@@ -345,7 +450,10 @@ def run(cfg: RunConfig) -> Tuple:
                         f"check stability parameters)")
             last_ok[0] = step
         if cfg.log_every and step % cfg.log_every == 0:
-            d = diagnostics.field_diagnostics(st, fs)
+            # step_fn gives diffusion models a Jacobi residual in the log
+            # (skip fused step_fns: they advance K steps, not one).
+            d = diagnostics.field_diagnostics(
+                st, fs, step_fn=None if cfg.fuse else step_fn)
             log.info("step %d  %s", step, diagnostics.format_diagnostics(d))
         if cfg.checkpoint_every and cfg.checkpoint_dir and \
                 step % cfg.checkpoint_every == 0:

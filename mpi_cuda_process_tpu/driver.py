@@ -81,6 +81,14 @@ def make_step(
             f"extents {tuple(global_shape)} makes the coloring inconsistent")
     update_fns = stencil.phases or (compute_fn or stencil.update,)
 
+    # NOTE (measured, round 3): a "raw" variant that skips jnp.pad by using
+    # the state as its own halo (frame cells ARE the guard cells) and
+    # splicing the interior back with dynamic_update_slice is bit-identical
+    # but ~13x SLOWER on TPU: the (n-2h)^3 intermediate is lane-misaligned
+    # (254 -> 384-lane relayout) and the splice un-fuses into a full copy.
+    # The pad -> update -> where chain below fuses to ~2 HBM passes at
+    # 256^3; where XLA's fusion loses at larger grids the answer is the
+    # Pallas whole-step kernel (ops/pallas/), not a different jnp layout.
     def one_pass(fields: Fields, update) -> Fields:
         padded = []
         for f, v, fh in zip(fields, stencil.bc_value, stencil.field_halos):

@@ -37,11 +37,24 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from ..stencil import Fields, Stencil
 
 # Whole-2D-grid kernels hold in+out in VMEM (~16 MB); cap well below that.
 _MAX_2D_VMEM_CELLS = 2 * 1024 * 1024
+
+# Mosaic's default scoped-vmem limit is 16 MiB — v5e physically has 128 MiB
+# of VMEM, and the z-chunk kernels want big chunks (the (bz+2h)/bz halo
+# re-read overhead shrinks with bz).  Raising the limit was the fix for the
+# round-2 "remote_compile HTTP 500" compile failures: at 256^3 the kernel's
+# true scoped usage (pipeline double-buffers + the in-kernel concatenate +
+# tap intermediates) was 17.3 MiB against the 16 MiB default.
+_VMEM_LIMIT_BYTES = 100 * 1024 * 1024
+_COMPILER_PARAMS = pltpu.CompilerParams(
+    vmem_limit_bytes=_VMEM_LIMIT_BYTES,
+    dimension_semantics=("arbitrary",),
+)
 
 
 def _interpret_default() -> bool:
@@ -122,13 +135,21 @@ def _zchunk_wave_kernel(c2dt2, bz, zc, ztail, prev, out_u):
 
 def _pick_bz(z: int, plane_bytes: int, extra_planes: int = 0,
              halo: int = 1) -> int:
-    # VMEM ~16MB; the pipeline double-buffers each spec:
-    # 2*(bz planes + 2*halo planes + out block (+ extras like wave's prev)).
-    budget = 11 * 1024 * 1024
-    for bz in (32, 16, 8, 4, 2):
+    # Scoped-VMEM cost model, fit to Mosaic's reported stack usage: the
+    # pipeline double-buffers every spec (in: bz + 2*halo planes + extras;
+    # out: bz planes), the kernel materializes the concatenated
+    # (bz + 2*halo)-plane slab, and the tap chain holds ~3 bz-plane
+    # intermediates live.  Keep the estimate under ~80% of the raised
+    # _VMEM_LIMIT_BYTES so Mosaic's own scratch still fits.
+    budget = int(_VMEM_LIMIT_BYTES * 0.8)
+    for bz in (64, 32, 16, 8, 4, 2):
         if z % bz or bz % (2 * halo):
             continue
-        if 2 * (2 * bz + 2 * halo + extra_planes) * plane_bytes <= budget:
+        est = (2 * (bz + 2 * halo + extra_planes)   # input pipeline buffers
+               + 2 * bz                             # output pipeline buffers
+               + (bz + 2 * halo)                    # in-kernel concatenate
+               + 3 * bz) * plane_bytes              # tap intermediates
+        if est <= budget:
             return bz
     return 0
 
@@ -173,6 +194,7 @@ def _heat3d_compute(stencil: Stencil, interpret: bool):
             out_specs=so,
             out_shape=jax.ShapeDtypeStruct((z, y, x), p.dtype),
             interpret=interpret,
+            compiler_params=None if interpret else _COMPILER_PARAMS,
         )(p, p)
         return (res,)
 
@@ -198,6 +220,7 @@ def _wave3d_compute(stencil: Stencil, interpret: bool):
             out_specs=so,
             out_shape=jax.ShapeDtypeStruct((z, y, x), p.dtype),
             interpret=interpret,
+            compiler_params=None if interpret else _COMPILER_PARAMS,
         )(p, p, prev)
         # slot 1 is dead (carry_map=(None, 0)); prev has the right shape
         return (new_u, prev)

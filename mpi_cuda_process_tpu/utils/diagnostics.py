@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from ..ops.stencil import Stencil
 
 
-def field_diagnostics(stencil: Stencil, fields) -> Dict[str, float]:
+def field_diagnostics(stencil: Stencil, fields, step_fn=None) -> Dict[str, float]:
     f0 = fields[0]
     out: Dict[str, float] = {}
     if stencil.name == "life":
@@ -30,13 +30,21 @@ def field_diagnostics(stencil: Stencil, fields) -> Dict[str, float]:
         # wave: discrete energy proxy |u - u_prev| (velocity magnitude)
         out["velocity_l2"] = float(
             jnp.sqrt(jnp.sum((fields[0] - fields[1]) ** 2)))
+    elif step_fn is not None and jnp.issubdtype(f0.dtype, jnp.inexact):
+        # diffusion-class models: how far from the Jacobi fixed point
+        out["residual"] = residual_norm(step_fn, fields)
     return out
 
 
 def residual_norm(step_fn, fields) -> float:
-    """L2 norm of one-step change — the Jacobi convergence residual."""
+    """L2 norm of one-step change — the Jacobi convergence residual.
+
+    Costs one extra (non-advancing) step evaluation; only run at logging
+    cadence (``--log-every``), never in the hot loop.
+    """
     new = step_fn(tuple(fields))
-    return float(jnp.sqrt(jnp.sum((new[0] - fields[0]) ** 2)))
+    return float(jnp.sqrt(jnp.sum(
+        (new[0].astype(jnp.float32) - fields[0].astype(jnp.float32)) ** 2)))
 
 
 def format_diagnostics(d: Dict[str, float]) -> str:

@@ -241,12 +241,16 @@ def test_sharded_matches_unsharded_property(case, mesh_i, local, steps, seed):
 
 
 @pytest.mark.slow
-@settings(max_examples=25, **_SETTINGS)
+@settings(max_examples=10, **_SETTINGS)
 @given(
     case=hs.sampled_from(_CASES),
     mesh_i=hs.integers(0, 10),
+    # every fresh (case, mesh, shape) combination costs a shard_map compile
+    # (~10s on CPU), so the example budget IS the wall-clock budget: 10
+    # free-shape examples ~= 90s, vs 25 at 230s in round 2 (the suite
+    # could not finish inside a 10-minute CI slot)
     local=hs.tuples(hs.integers(2, 5), hs.integers(2, 5), hs.integers(2, 5)),
-    steps=hs.integers(1, 3),
+    steps=hs.integers(1, 2),
     seed=hs.integers(0, 2**16),
 )
 def test_sharded_matches_unsharded_property_wide(case, mesh_i, local, steps,
@@ -284,7 +288,7 @@ def test_sharded_width_k_halo(halo):
 
 
 @pytest.mark.slow
-@settings(max_examples=10, **_SETTINGS)
+@settings(max_examples=5, **_SETTINGS)
 @given(
     halo=hs.integers(1, 3),
     mesh_i=hs.integers(0, 10),

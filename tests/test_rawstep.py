@@ -1,0 +1,64 @@
+"""Whole-step raw Pallas kernels == driver.make_step.
+
+Interpret-mode equivalence (SURVEY.md §4.4's Pallas CI strategy): the raw
+kernels replace the ENTIRE pad -> update -> frame-re-pin step, so the
+invariant is stronger than the compute_fn kernels' — the whole step function
+must match, frame semantics included, over multiple steps.  Tolerance is a
+few ULP at the field's scale (not bit-exact: XLA may contract mul+add to FMA
+differently in the two graphs), except the frame cells, which both paths
+must preserve verbatim.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_process_tpu import driver
+from mpi_cuda_process_tpu.ops import make_stencil
+from mpi_cuda_process_tpu.ops.pallas import rawstep
+from mpi_cuda_process_tpu.utils.init import init_state
+
+CASES = [
+    ("heat3d", (16, 18, 130), {}),
+    ("heat3d", (8, 10, 12), {"dtype": jnp.bfloat16}),
+    ("heat3d27", (16, 12, 14), {}),
+    ("heat3d4th", (16, 14, 130), {}),
+    ("wave3d", (16, 18, 12), {}),
+]
+
+
+@pytest.mark.parametrize("name,grid,kw", CASES,
+                         ids=[f"{n}-{'x'.join(map(str, g))}"
+                              for n, g, kw in CASES])
+def test_raw_step_matches_driver(name, grid, kw):
+    st = make_stencil(name, **kw)
+    raw = rawstep.make_raw_step(st, grid, interpret=True)
+    assert raw is not None, "tileable case must build"
+    ref = driver.make_step(st, grid)
+    a = b = init_state(st, grid, 3, 0.2, "auto")
+    for _ in range(4):
+        a, b = raw(a), ref(b)
+    eps = float(jnp.finfo(st.dtype).eps)
+    scale = max(float(jnp.max(jnp.abs(b[0]).astype(jnp.float32))), 1.0)
+    for x, y in zip(a, b):
+        xn = np.asarray(x, dtype=np.float32)
+        yn = np.asarray(y, dtype=np.float32)
+        np.testing.assert_allclose(xn, yn, rtol=0, atol=32 * eps * scale)
+        # frame cells: verbatim, no tolerance
+        h = st.halo
+        for d in range(3):
+            lo = [slice(None)] * 3
+            hi = [slice(None)] * 3
+            lo[d], hi[d] = slice(0, h), slice(-h, None)
+            np.testing.assert_array_equal(xn[tuple(lo)], yn[tuple(lo)])
+            np.testing.assert_array_equal(xn[tuple(hi)], yn[tuple(hi)])
+
+
+def test_unsupported_returns_none():
+    st2d = make_stencil("heat2d")
+    assert rawstep.make_raw_step(st2d, (32, 32), interpret=True) is None
+    life = make_stencil("life")
+    assert not rawstep.raw_step_supported(life)
+    st = make_stencil("heat3d")
+    # untileable Z (prime) -> None, caller falls back to jnp
+    assert rawstep.make_raw_step(st, (7, 16, 16), interpret=True) is None

@@ -44,28 +44,37 @@ def _compare(name, grid, mesh_shape, steps=5, periodic=False, **params):
                 np.asarray(g), np.asarray(r), rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("mesh_shape", [(2,), (4,), (8,), (2, 2), (2, 4), (4, 2)])
+# Mesh ladders are deliberately minimal: every fresh (stencil, mesh) pair
+# costs a shard_map compile (~25s on the 8-virtual-device CPU backend), and
+# round 2's full ladder put this file alone past a 10-minute CI budget.
+# Coverage kept: 1-D row split, 2-D, asymmetric 2-D (life); 3-D and
+# asymmetric 3-D (heat3d); corner exchange (heat27); halo-2 + carry
+# (test_overlap.py); free-shape meshes (test_properties.py wide tier).
+@pytest.mark.parametrize("mesh_shape", [
+    (2,), (2, 2),
+    pytest.param((4, 2), marks=pytest.mark.slow),  # asymmetric 2-D
+])
 def test_life_sharded_bitexact(mesh_shape):
     _compare("life", (16, 24), mesh_shape, steps=6)
 
 
-@pytest.mark.parametrize("mesh_shape", [(2,), (2, 2), (4, 2)])
+@pytest.mark.parametrize("mesh_shape", [(2, 2)])
 def test_heat2d_sharded(mesh_shape):
     _compare("heat2d", (16, 16), mesh_shape)
 
 
-@pytest.mark.parametrize("mesh_shape", [(2,), (2, 2), (2, 2, 2), (1, 2, 4)])
+@pytest.mark.parametrize("mesh_shape", [(2, 2, 2), (1, 2, 4)])
 def test_heat3d_sharded(mesh_shape):
     _compare("heat3d", (8, 8, 8), mesh_shape)
 
 
-@pytest.mark.parametrize("mesh_shape", [(2, 2), (2, 2, 2)])
+@pytest.mark.parametrize("mesh_shape", [(2, 2)])
 def test_heat27_sharded_corners(mesh_shape):
     """27-point needs diagonal halo data — exercises the two-pass exchange."""
     _compare("heat3d27", (8, 8, 8), mesh_shape, alpha=0.1)
 
 
-@pytest.mark.parametrize("mesh_shape", [(2, 2), (2, 2, 2)])
+@pytest.mark.parametrize("mesh_shape", [(2, 2)])
 def test_wave_sharded(mesh_shape):
     _compare("wave3d", (8, 8, 8), mesh_shape, c2dt2=0.1)
 
