@@ -48,13 +48,13 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
     step_unit = 1
     if compute == "raw":
         from mpi_cuda_process_tpu.ops.pallas.rawstep import make_raw_step
-        step = make_raw_step(st, grid, interpret=False)
+        step = make_raw_step(st, grid)  # interpret mode off-TPU (smoke)
         if step is None:
             raise ValueError(f"no raw step for {name} on {grid}")
     elif compute.startswith("fused"):
         from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
         step_unit = int(compute[len("fused"):])
-        step = make_fused_step(st, grid, step_unit, interpret=False)
+        step = make_fused_step(st, grid, step_unit)
         if step is None:
             raise ValueError(f"untileable fused k={step_unit} for {grid}")
     else:
@@ -121,6 +121,17 @@ CONFIGS = [
     ("heat3d_512_f32_fused4", "heat3d", (512, 512, 512), 10, "float32",
      "fused4"),
     ("heat3d_512_bf16_fused4", "heat3d", (512, 512, 512), 10, "bfloat16",
+     "fused4"),
+    # fused families (round 3: generalized to 27-point, halo-2, two-field)
+    ("heat3d27_256_f32_fused4", "heat3d27", (256, 256, 256), 15, "float32",
+     "fused4"),
+    ("heat3d27_512_f32_fused4", "heat3d27", (512, 512, 512), 8, "float32",
+     "fused4"),
+    ("heat3d4th_256_f32_fused2", "heat3d4th", (256, 256, 256), 20, "float32",
+     "fused2"),
+    ("wave3d_256_f32_fused4", "wave3d", (256, 256, 256), 15, "float32",
+     "fused4"),
+    ("wave3d_512_f32_fused4", "wave3d", (512, 512, 512), 8, "float32",
      "fused4"),
     # 1024^3 bf16: 2.1 GiB/buffer — the largest-grid single-chip point
     # (VERDICT item 3); jnp vs raw vs fused

@@ -47,7 +47,15 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..stencil import Fields, Stencil
 
-from .kernels import _VMEM_LIMIT_BYTES
+from .kernels import (
+    _VMEM_LIMIT_BYTES,
+    _W27_CENTER,
+    _W27_CORNER,
+    _W27_EDGE,
+    _W27_FACE,
+    _interpret_default,
+    _roll,
+)
 
 # Scoped-VMEM cost model for auto-tiling, fit to Mosaic's reported stack
 # usage: ~7 live copies of the window + ~2 of the output block.  Round 3
@@ -58,80 +66,178 @@ from .kernels import _VMEM_LIMIT_BYTES
 _VMEM_LIMIT = int(_VMEM_LIMIT_BYTES * 0.8)
 
 
-def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
+# ---------------------------------------------------------------------------
+# per-stencil micro-steps: (fields-of-windows, frame) -> fields-of-windows.
+# Every neighbor tap is a **roll** (no shrinking slices): sublane/lane
+# slicing at odd offsets forces a Mosaic relayout per tap per micro-step,
+# which measured ~5x slower than the XLA path; rolls keep every operand at
+# the same aligned (bz+2m, by+2m, X) layout.  Wrap-around values from the
+# rolls land only in (a) the tile's outermost shell, which temporal validity
+# excludes anyway — after m micro-steps only cells >= m*halo away from the
+# window edge are correct, and only the inner (bz, by) core is written out —
+# and (b) the global domain walls, which the frame mask re-pins every
+# micro-step (the in-VMEM equivalent of the driver's per-step frame mask;
+# out-of-domain ghost cells of edge tiles are pinned too, bounding their
+# garbage).
+# ---------------------------------------------------------------------------
 
 
-def _roll(x, shift, axis, interpret):
-    if interpret:
-        return jnp.roll(x, shift, axis)
-    return pltpu.roll(x, shift % x.shape[axis], axis)
+def _lap7(cur, interpret):
+    return (
+        _roll(cur, 1, 0, interpret) + _roll(cur, -1, 0, interpret)
+        + _roll(cur, 1, 1, interpret) + _roll(cur, -1, 1, interpret)
+        + _roll(cur, 1, 2, interpret) + _roll(cur, -1, 2, interpret)
+        - 6.0 * cur
+    )
 
 
-def _fused_kernel_7pt(alpha, k, bz, by, shape, interpret, a, b, c, d, out):
-    """k FTCS micro-steps on a constant-shape VMEM window.
+def _micro_heat3d(stencil, interpret):
+    alpha = float(stencil.params["alpha"])
 
-    Every neighbor tap is a **roll** (no shrinking slices): sublane/lane
-    slicing at odd offsets forces a Mosaic relayout per tap per micro-step,
-    which measured ~5x slower than the XLA path; rolls keep every operand at
-    the same aligned (bz+2k, by+2k, X) layout.  Wrap-around values from the
-    rolls land only in (a) the tile's outermost shell, which temporal validity
-    excludes anyway — after m micro-steps only cells >= m away from the window
-    edge are correct, and only the inner (bz, by) core is written out — and
-    (b) the global domain walls, which the precomputed frame mask re-pins
-    every micro-step (the in-VMEM equivalent of the driver's per-step frame
-    mask; out-of-domain ghost cells of edge tiles are pinned too, bounding
-    their garbage).
-    """
-    # Reassemble the (bz+2k, by+2k, X) overlapping window from the four
-    # aligned blocks (core, y-tail, z-tail, corner).
+    def micro(fields, frame):
+        (cur,) = fields
+        new = cur + alpha * _lap7(cur, interpret)
+        return (jnp.where(frame, cur, new),)
+
+    return micro
+
+
+def _micro_heat3d27(stencil, interpret):
+    # Same per-z-level separable partials as rawstep._taps27: the in-plane
+    # 3x3 kernel is [center', face', edge'] over {self, y/x lines,
+    # diagonals}, and the dz=+-1 levels share one combination, rolled both
+    # ways in z.  8 rolls per micro-step, ~5 live window buffers.
+    alpha = float(stencil.params["alpha"])
+
+    def micro(fields, frame):
+        (cur,) = fields
+        yl = _roll(cur, 1, 1, interpret) + _roll(cur, -1, 1, interpret)
+        xl = _roll(cur, 1, 2, interpret) + _roll(cur, -1, 2, interpret)
+        diag = _roll(yl, 1, 2, interpret) + _roll(yl, -1, 2, interpret)
+        level0 = (_W27_CENTER * cur + _W27_FACE * (yl + xl)
+                  + _W27_EDGE * diag)
+        level1 = (_W27_FACE * cur + _W27_EDGE * (yl + xl)
+                  + _W27_CORNER * diag)
+        acc = (level0 + _roll(level1, 1, 0, interpret)
+               + _roll(level1, -1, 0, interpret))
+        return (jnp.where(frame, cur, cur + alpha * acc),)
+
+    return micro
+
+
+def _micro_heat3d4th(stencil, interpret):
+    # 4th-order 13-point Laplacian, halo 2: taps at distance 1 and 2.
+    alpha = float(stencil.params["alpha"])
+    w = {1: 16.0 / 12.0, 2: -1.0 / 12.0}
+    c = -30.0 / 12.0 * 3.0
+
+    def micro(fields, frame):
+        (cur,) = fields
+        acc = c * cur
+        for dist in (1, 2):
+            for o in (-dist, dist):
+                acc = acc + w[dist] * (
+                    _roll(cur, -o, 0, interpret)
+                    + _roll(cur, -o, 1, interpret)
+                    + _roll(cur, -o, 2, interpret)
+                )
+        return (jnp.where(frame, cur, cur + alpha * acc),)
+
+    return micro
+
+
+def _micro_wave3d(stencil, interpret):
+    c2dt2 = float(stencil.params["c2dt2"])
+
+    def micro(fields, frame):
+        u, uprev = fields
+        new = 2.0 * u - uprev + c2dt2 * _lap7(u, interpret)
+        # leapfrog carry: new u_prev is the old u, verbatim (no pin needed
+        # — its frame is correct by induction, exactly carry_map's rule)
+        return (jnp.where(frame, u, new), u)
+
+    return micro
+
+
+# name -> (micro factory, halo, carried fields)
+_MICRO = {
+    "heat3d": (_micro_heat3d, 1, 1),
+    "heat3d27": (_micro_heat3d27, 1, 1),
+    "heat3d4th": (_micro_heat3d4th, 2, 1),
+    "wave3d": (_micro_wave3d, 1, 2),
+}
+
+
+def _assemble_window(a, b, c, d):
     top = jnp.concatenate([a[...], b[...]], axis=1)
     bot = jnp.concatenate([c[...], d[...]], axis=1)
-    cur = jnp.concatenate([top, bot], axis=0)
-    iz = pl.program_id(0)
-    iy = pl.program_id(1)
-    # Window origin in global coordinates (input was pre-padded by k in z/y).
-    z0 = iz * bz - k
-    y0 = iy * by - k
-    Z, Y, X = shape
-    zidx = jax.lax.broadcasted_iota(jnp.int32, cur.shape, 0) + z0
-    yidx = jax.lax.broadcasted_iota(jnp.int32, cur.shape, 1) + y0
-    xidx = jax.lax.broadcasted_iota(jnp.int32, cur.shape, 2)
-    frame = (
-        (zidx <= 0) | (zidx >= Z - 1)
-        | (yidx <= 0) | (yidx >= Y - 1)
-        | (xidx == 0) | (xidx == X - 1)
-    )
-    for _ in range(k):
-        lap = (
-            _roll(cur, 1, 0, interpret)
-            + _roll(cur, -1, 0, interpret)
-            + _roll(cur, 1, 1, interpret)
-            + _roll(cur, -1, 1, interpret)
-            + _roll(cur, 1, 2, interpret)
-            + _roll(cur, -1, 2, interpret)
-            - 6.0 * cur
+    return jnp.concatenate([top, bot], axis=0)
+
+
+def _fused_kernel(micro, nfields, k, margin, bz, by, shape, interpret, *refs):
+    """k micro-steps on constant-shape VMEM windows; multi-field generic.
+
+    ``refs`` is 4 window blocks per field (core, y-tail, z-tail, corner —
+    overlapping BlockSpecs must start block-aligned, hence the assembly),
+    then — when ``shape`` is None — 4 blocks of a precomputed frame-mask
+    array, followed by ``nfields`` output blocks.  ``margin = k * halo`` is
+    the temporal-validity margin consumed by the k micro-steps.
+
+    ``shape`` carries the global (Z, Y, X) for the single-device case,
+    where the frame mask is derived from ``program_id``; the sharded caller
+    passes ``shape=None`` and supplies the mask as a windowed input instead
+    (each shard's global origin is a traced axis_index, which a BlockSpec
+    index_map cannot see).
+    """
+    fields = tuple(
+        _assemble_window(*refs[4 * f:4 * f + 4]) for f in range(nfields))
+    if shape is None:
+        frame = _assemble_window(*refs[4 * nfields:4 * nfields + 4]) != 0
+        outs = refs[4 * nfields + 4:]
+    else:
+        outs = refs[4 * nfields:]
+        iz = pl.program_id(0)
+        iy = pl.program_id(1)
+        # Window origin in global coords (input pre-padded by margin in z/y).
+        z0 = iz * bz - margin
+        y0 = iy * by - margin
+        Z, Y, X = shape
+        halo = margin // k
+        like = fields[0]
+        zidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 0) + z0
+        yidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 1) + y0
+        xidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 2)
+        frame = (
+            (zidx < halo) | (zidx >= Z - halo)
+            | (yidx < halo) | (yidx >= Y - halo)
+            | (xidx < halo) | (xidx >= X - halo)
         )
-        cur = jnp.where(frame, cur, cur + alpha * lap)
-    out[...] = cur[k:bz + k, k:by + k, :]
+    for _ in range(k):
+        fields = micro(fields, frame)
+    for o, f in zip(outs, fields):
+        o[...] = f[margin:bz + margin, margin:by + margin, :]
 
 
 def _lane_round(n: int) -> int:
     return -(-n // 128) * 128
 
 
-def _pick_tiles(Z: int, Y: int, X: int, k: int, itemsize: int):
-    """Choose (bz, by) dividing (Z, Y), multiples of 2k, fitting scoped VMEM."""
-    if (2 * k) % 8:
+def _pick_tiles(Z: int, Y: int, X: int, margin: int, itemsize: int,
+                nfields: int):
+    """Choose (bz, by) dividing (Z, Y), multiples of 2*margin, fitting VMEM."""
+    if (2 * margin) % 8:
         return None  # y-tail blocks must be sublane-aligned
     best = None
     for bz in (64, 32, 16, 8):
         for by in (64, 32, 16, 8):
-            if Z % bz or Y % by or bz % (2 * k) or by % (2 * k):
+            if Z % bz or Y % by or bz % (2 * margin) or by % (2 * margin):
                 continue
-            window = (bz + 2 * k) * (by + 2 * k) * _lane_round(X) * itemsize
+            window = ((bz + 2 * margin) * (by + 2 * margin)
+                      * _lane_round(X) * itemsize)
             core = bz * by * _lane_round(X) * itemsize
-            if 7 * window + 2 * core > _VMEM_LIMIT:
+            # ~7 live window copies per field (pipeline buffers + the
+            # micro-step temporaries) + the output pipeline buffers
+            if (7 * window + 2 * core) * nfields > _VMEM_LIMIT:
                 continue
             # prefer max core/window ratio (least redundancy), then max core
             score = (core / window, core)
@@ -141,7 +247,76 @@ def _pick_tiles(Z: int, Y: int, X: int, k: int, itemsize: int):
 
 
 def fused_supported(stencil: Stencil) -> bool:
-    return stencil.name == "heat3d"
+    return stencil.name in _MICRO
+
+
+def build_fused_call(
+    stencil: Stencil,
+    core_shape: Tuple[int, int, int],
+    k: int,
+    tiles: Optional[Tuple[int, int]] = None,
+    interpret: Optional[bool] = None,
+    masked: bool = False,
+):
+    """Construct the fused pallas_call over a (core) block of ``core_shape``.
+
+    Returns ``(call, margin, nfields)`` or None if untileable.  The call
+    takes, per field, 4 views of the z/y-padded block (pass the same padded
+    array 4 times) — plus, when ``masked``, 4 views of a same-shape
+    frame-mask array (nonzero = pinned) — and returns ``nfields`` arrays of
+    ``core_shape``.  ``masked=False`` derives the mask from program ids and
+    the global shape (single-device use); ``masked=True`` is for callers
+    whose blocks sit at a traced global offset (shard_map).
+    """
+    if not fused_supported(stencil):
+        return None
+    if interpret is None:
+        interpret = _interpret_default()
+    micro_factory, halo, nfields = _MICRO[stencil.name]
+    margin = k * halo
+    Z, Y, X = (int(s) for s in core_shape)
+    itemsize = jnp.dtype(stencil.dtype).itemsize
+    if tiles is None:
+        tiles = _pick_tiles(Z, Y, X, margin, itemsize,
+                            nfields + (1 if masked else 0))
+    if tiles is None:
+        return None
+    bz, by = tiles
+    micro = micro_factory(stencil, interpret)
+
+    grid = (Z // bz, Y // by)
+    m = margin
+    # Four aligned views of the z/y-padded input reassemble each program's
+    # overlapping (bz+2m, by+2m, X) window; alignment needs bz, by % 2m == 0.
+    per_field_specs = [
+        pl.BlockSpec((bz, by, X), lambda i, j: (i, j, 0)),
+        pl.BlockSpec(
+            (bz, 2 * m, X), lambda i, j: (i, (j + 1) * by // (2 * m), 0)),
+        pl.BlockSpec(
+            (2 * m, by, X), lambda i, j: ((i + 1) * bz // (2 * m), j, 0)),
+        pl.BlockSpec(
+            (2 * m, 2 * m, X),
+            lambda i, j: ((i + 1) * bz // (2 * m),
+                          (j + 1) * by // (2 * m), 0)),
+    ]
+    out_spec = pl.BlockSpec((bz, by, X), lambda i, j: (i, j, 0))
+    n_in_sets = nfields + (1 if masked else 0)
+
+    call = pl.pallas_call(
+        functools.partial(
+            _fused_kernel, micro, nfields, k, m, bz, by,
+            None if masked else (Z, Y, X), interpret),
+        grid=grid,
+        in_specs=per_field_specs * n_in_sets,
+        out_specs=[out_spec] * nfields,
+        out_shape=[jax.ShapeDtypeStruct((Z, Y, X), stencil.dtype)
+                   for _ in range(nfields)],
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT_BYTES,
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )
+    return call, margin, nfields
 
 
 def make_fused_step(
@@ -156,52 +331,19 @@ def make_fused_step(
     Semantically identical to ``k`` applications of ``driver.make_step`` for
     the same stencil/shape (guard-frame semantics included) — asserted by
     tests/test_fused.py.  Returns None when the shape/k cannot be tiled
-    (callers fall back to the per-step path).  ``k`` must satisfy
-    ``2k % 8 == 0`` (sublane alignment of the tail blocks), i.e. k in
-    {4, 8, 12, ...}.
+    (callers fall back to the per-step path).  ``2 * k * halo`` must be a
+    multiple of 8 (sublane alignment of the tail blocks), i.e. k in
+    {4, 8, ...} for halo-1 stencils and {2, 4, ...} for halo-2.
     """
-    if not fused_supported(stencil):
+    built = build_fused_call(
+        stencil, tuple(int(s) for s in global_shape), k, tiles, interpret)
+    if built is None:
         return None
-    if interpret is None:
-        interpret = _interpret_default()
-    Z, Y, X = (int(s) for s in global_shape)
-    itemsize = jnp.dtype(stencil.dtype).itemsize
-    if tiles is None:
-        tiles = _pick_tiles(Z, Y, X, k, itemsize)
-    if tiles is None:
-        return None
-    bz, by = tiles
-    alpha = float(stencil.params["alpha"])
-
-    grid = (Z // bz, Y // by)
-    # Four aligned views of the z/y-padded input reassemble each program's
-    # overlapping (bz+2k, by+2k, X) window; alignment needs bz, by % 2k == 0.
-    a = pl.BlockSpec((bz, by, X), lambda i, j: (i, j, 0))
-    b = pl.BlockSpec(
-        (bz, 2 * k, X), lambda i, j: (i, (j + 1) * by // (2 * k), 0))
-    c = pl.BlockSpec(
-        (2 * k, by, X), lambda i, j: ((i + 1) * bz // (2 * k), j, 0))
-    d = pl.BlockSpec(
-        (2 * k, 2 * k, X),
-        lambda i, j: ((i + 1) * bz // (2 * k), (j + 1) * by // (2 * k), 0))
-    out_spec = pl.BlockSpec((bz, by, X), lambda i, j: (i, j, 0))
-
-    call = pl.pallas_call(
-        functools.partial(
-            _fused_kernel_7pt, alpha, k, bz, by, (Z, Y, X), interpret),
-        grid=grid,
-        in_specs=[a, b, c, d],
-        out_specs=out_spec,
-        out_shape=jax.ShapeDtypeStruct((Z, Y, X), stencil.dtype),
-        interpret=interpret,
-        compiler_params=None if interpret else pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT_BYTES,
-            dimension_semantics=("arbitrary", "arbitrary")),
-    )
+    call, m, _ = built
 
     def step_k(fields: Fields) -> Fields:
-        (u,) = fields
-        p = jnp.pad(u, ((k, k), (k, k), (0, 0)))
-        return (call(p, p, p, p),)
+        padded = [jnp.pad(f, ((m, m), (m, m), (0, 0))) for f in fields]
+        args = [p for p in padded for _ in range(4)]
+        return tuple(call(*args))
 
     return step_k

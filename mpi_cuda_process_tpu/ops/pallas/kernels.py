@@ -61,10 +61,24 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _roll(x, shift, axis, interpret):
+    """In-VMEM roll: jnp in interpret mode, pltpu.roll on hardware.
+
+    Single definition shared by every Pallas module (fused.py, rawstep.py)
+    — neighbor taps as rolls keep operands at one aligned layout, where
+    odd-offset sublane/lane slices force a Mosaic relayout per tap.
+    """
+    if interpret:
+        return jnp.roll(x, shift, axis)
+    return pltpu.roll(x, shift % x.shape[axis], axis)
+
+
 # ----------------------------------------------------------------------------
 # 3D: z-chunk kernels
 # ----------------------------------------------------------------------------
 
+# Isotropic 27-point Laplacian weights (x 1/30) — single source of truth for
+# every Pallas variant; must match ops/heat.py's jnp op.
 _W27_FACE, _W27_EDGE, _W27_CORNER = 14.0 / 30.0, 3.0 / 30.0, 1.0 / 30.0
 _W27_CENTER = -128.0 / 30.0
 

@@ -43,20 +43,16 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..stencil import Fields, Stencil
-from .kernels import _COMPILER_PARAMS, _VMEM_LIMIT_BYTES
-
-_W27_FACE, _W27_EDGE, _W27_CORNER = 14.0 / 30.0, 3.0 / 30.0, 1.0 / 30.0
-_W27_CENTER = -128.0 / 30.0
-
-
-def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-def _roll(x, shift, axis, interpret):
-    if interpret:
-        return jnp.roll(x, shift, axis)
-    return pltpu.roll(x, shift % x.shape[axis], axis)
+from .kernels import (
+    _COMPILER_PARAMS,
+    _VMEM_LIMIT_BYTES,
+    _W27_CENTER,
+    _W27_CORNER,
+    _W27_EDGE,
+    _W27_FACE,
+    _interpret_default,
+    _roll,
+)
 
 
 def _roll2(x, dy, dx, interpret):

@@ -208,3 +208,105 @@ def make_sharded_step(
         out_specs=spec,
         check_vma=False,
     )
+
+
+def make_sharded_fused_step(
+    stencil: Stencil,
+    mesh: Mesh,
+    global_shape: Sequence[int],
+    k: int,
+    interpret: Optional[bool] = None,
+):
+    """Temporal blocking under domain decomposition: k steps per exchange.
+
+    The distributed analogue of ``ops.pallas.fused.make_fused_step`` — and
+    the configuration the 4096^3 north star actually needs (BASELINE.json
+    config 5: too big for one chip AND bandwidth-bound).  One call =
+
+      1. width ``k*halo`` halo exchange on the sharded z/y axes (the
+         two-pass axis-wise ``ppermute`` scheme, amortized over k steps —
+         k x fewer exchanges than stepping singly), local bc-pad on
+         unsharded axes;
+      2. the fused k-micro-step Pallas kernel on the padded local block.
+
+    The global guard frame is pinned every micro-step via a precomputed
+    mask array (nonzero = frame/out-of-domain cell) handed to the kernel as
+    a windowed input: each shard's global origin is a traced axis_index,
+    so the kernel cannot derive the mask from program ids the way the
+    single-device path does.
+
+    Constraints (returns None when unmet, callers fall back):
+      * 3D stencil with a fused kernel (fused_supported);
+      * the lane axis x (grid axis 2) unsharded — the kernel's x taps are
+        lane rolls of full rows;
+      * local z/y extents tileable per ``_pick_tiles`` (multiples of
+        ``2*k*halo`` >= 8).
+
+    Every field is exchanged at width ``k*halo`` regardless of
+    ``field_halos`` — temporal blocking consumes spatial margin for ALL
+    fields (wave's u_prev is read pointwise across the shrinking validity
+    window), so the per-field-halo elision that applies to single steps
+    does not apply here.
+    """
+    from ..ops.pallas.fused import build_fused_call, fused_supported
+
+    ndim = stencil.ndim
+    if ndim != 3 or not fused_supported(stencil) or stencil.phases:
+        return None
+    from .mesh import spatial_axis_names
+
+    names_all = spatial_axis_names(ndim)
+    axis_names = tuple(n if n in mesh.shape else None for n in names_all)
+    counts = tuple(mesh.shape.get(n, 1) if n else 1 for n in axis_names)
+    if counts[2] > 1:
+        return None  # lane axis must stay whole (in-kernel lane rolls)
+    if any(g % c for g, c in zip(global_shape, counts)):
+        return None
+    local_shape = tuple(g // c for g, c in zip(global_shape, counts))
+    built = build_fused_call(stencil, local_shape, k, interpret=interpret,
+                             masked=True)
+    if built is None:
+        return None
+    call, m, nfields = built
+    # (one-shard-neighbor invariant — a width-m slab must come from a single
+    # neighbor — is already guaranteed: _pick_tiles only accepts local z/y
+    # extents divisible by tiles that are multiples of 2*m)
+    spec = grid_partition_spec(ndim, mesh)
+
+    def local_step(fields: Fields) -> Fields:
+        from .halo import exchange_pad_axis
+
+        padded = []
+        for f, bc in zip(fields, stencil.bc_value):
+            for d in (0, 1):
+                f = exchange_pad_axis(
+                    f, d, axis_names[d], counts[d], m, bc)
+            padded.append(f)
+        # frame mask over the padded block, from global coordinates
+        # (nonzero = pinned: the guard frame AND out-of-domain pad cells)
+        offs = tuple(
+            lax.axis_index(n) * ls if n else 0
+            for n, ls in zip(axis_names, local_shape)
+        )
+        h = stencil.halo
+        pshape = padded[0].shape
+        mask = None
+        for d in range(3):
+            pad_d = m if d < 2 else 0
+            coord = (lax.broadcasted_iota(jnp.int32, pshape, d)
+                     + offs[d] - pad_d)
+            g = global_shape[d]
+            md = (coord < h) | (coord >= g - h)
+            mask = md if mask is None else mask | md
+        maskf = mask.astype(stencil.dtype)
+        args = [p for p in padded for _ in range(4)]
+        args += [maskf] * 4
+        return tuple(call(*args))
+
+    return shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=spec,
+        check_vma=False,
+    )

@@ -251,11 +251,22 @@ def test_fuse_matches_plain_run():
         np.asarray(fused[0]), np.asarray(plain[0]))
 
 
+def test_fuse_plus_mesh_matches_plain_run():
+    """--fuse K + --mesh: k fused steps per width-k exchange, same results."""
+    base = dict(stencil="heat3d", grid=(16, 16, 128), iters=8, init="random",
+                seed=2)
+    plain, _ = run(RunConfig(**base))
+    fused, _ = run(RunConfig(**base, fuse=4, mesh=(2, 2, 1)))
+    np.testing.assert_allclose(
+        np.asarray(fused[0]), np.asarray(plain[0]), rtol=0, atol=1e-4)
+
+
 def test_fuse_rejects_bad_configs():
     import pytest
     with pytest.raises(ValueError, match="fuse"):
-        build(RunConfig(stencil="heat3d", grid=(16, 16, 128), iters=8,
-                        fuse=4, mesh=(2, 1, 1)))
+        # sharded lane axis: in-kernel lane rolls need whole x rows
+        build(RunConfig(stencil="heat3d", grid=(16, 16, 256), iters=8,
+                        fuse=4, mesh=(1, 1, 2)))
     with pytest.raises(ValueError, match="fuse"):
         build(RunConfig(stencil="life", grid=(16, 16), iters=8, fuse=4))
 

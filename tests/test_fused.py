@@ -39,6 +39,37 @@ def test_fused_matches_plain_steps(shape, k):
     assert jnp.array_equal(out[0], ref[0])
 
 
+@pytest.mark.parametrize(
+    "name,shape,k,kw",
+    [
+        ("heat3d27", (16, 16, 128), 4, {"alpha": 0.1}),
+        ("heat3d4th", (16, 16, 128), 2, {}),   # halo 2: margin 4, 2m=8
+        ("wave3d", (16, 16, 128), 4, {}),      # two-field leapfrog carry
+    ],
+)
+def test_fused_families_match_plain_steps(name, shape, k, kw):
+    st = make_stencil(name, **kw)
+    fields = init_state(st, shape, seed=5, kind="pulse")
+    step = jax.jit(make_step(st, shape))
+    ref = fields
+    for _ in range(k):
+        ref = step(ref)
+    fused = make_fused_step(st, shape, k, interpret=True)
+    assert fused is not None
+    out = jax.jit(fused)(fields)
+    assert len(out) == len(ref)
+    for o, r in zip(out, ref):
+        # micro-step tap order differs from the jnp update's association
+        # order, so a few-ULP tolerance (frame cells still verbatim below)
+        assert jnp.allclose(o, r, rtol=0, atol=1e-4), name
+    for o, r in zip(out, ref):
+        for d in range(3):
+            for sl in (slice(0, st.halo), slice(-st.halo, None)):
+                idx = [slice(None)] * 3
+                idx[d] = sl
+                assert jnp.array_equal(o[tuple(idx)], r[tuple(idx)])
+
+
 def test_fused_in_scan_runner(_k=4, _n=3):
     st = make_stencil("heat3d")
     shape = (16, 16, 128)
@@ -71,7 +102,53 @@ def test_unsupported_configs_return_none():
     # k with 2k % 8 != 0 (sublane alignment) is rejected
     assert make_fused_step(st, (16, 16, 128), 2, interpret=True) is None
     # shapes not tileable into aligned blocks are rejected
-    assert _pick_tiles(10, 16, 128, 4, 4) is None
-    # only the flagship 7-point model has a fused kernel so far
+    assert _pick_tiles(10, 16, 128, 4, 4, 1) is None
+    # 2D models have no fused kernel
     assert make_fused_step(
         make_stencil("life"), (32, 32), 4, interpret=True) is None
+
+
+# ---------------------------------------------------------------------------
+# sharded + fused composition: k fused steps per width-k*halo exchange
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "name,grid,mesh_shape,k,kw",
+    [
+        ("heat3d", (16, 16, 128), (2, 2, 1), 4, {}),
+        ("heat3d27", (16, 16, 128), (2, 1, 1), 4, {"alpha": 0.1}),
+        ("wave3d", (32, 16, 128), (2, 2, 1), 4, {}),
+    ],
+)
+def test_sharded_fused_matches_unsharded(name, grid, mesh_shape, k, kw):
+    from mpi_cuda_process_tpu import make_mesh, shard_fields
+    from mpi_cuda_process_tpu.parallel.stepper import make_sharded_fused_step
+
+    st = make_stencil(name, **kw)
+    fields = init_state(st, grid, seed=9, kind="pulse")
+    ref = fields
+    step = jax.jit(make_step(st, grid))
+    for _ in range(k):
+        ref = step(ref)
+
+    mesh = make_mesh(mesh_shape)
+    fused = make_sharded_fused_step(st, mesh, grid, k, interpret=True)
+    assert fused is not None
+    got = jax.jit(fused)(shard_fields(fields, mesh, 3))
+    for g, r in zip(got, ref):
+        assert jnp.allclose(g, r, rtol=0, atol=1e-4), name
+
+
+def test_sharded_fused_unsupported_configs():
+    from mpi_cuda_process_tpu import make_mesh
+    from mpi_cuda_process_tpu.parallel.stepper import make_sharded_fused_step
+
+    st = make_stencil("heat3d")
+    # sharded lane axis -> None (in-kernel lane rolls need whole rows)
+    mesh = make_mesh((1, 1, 2))
+    assert make_sharded_fused_step(
+        st, mesh, (16, 16, 256), 4, interpret=True) is None
+    # local block smaller than the k*halo margin -> None
+    mesh2 = make_mesh((4, 1, 1))
+    assert make_sharded_fused_step(
+        st, mesh2, (16, 16, 128), 8, interpret=True) is None
