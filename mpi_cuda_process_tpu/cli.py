@@ -88,9 +88,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(seeds seed..seed+N-1)")
     p.add_argument("--compute", default="auto",
                    choices=["auto", "jnp", "pallas"],
-                   help="local block update implementation (auto: jnp for "
-                        "7-point-class stencils where XLA fuses to roofline, "
-                        "pallas where the hand kernel wins)")
+                   help="execution strategy (auto: the measured-fastest "
+                        "path per stencil/size — temporal-blocking or raw "
+                        "whole-step Pallas kernels where they beat XLA's "
+                        "fusion, jnp elsewhere; falls back to jnp if a "
+                        "kernel fails, never crashes a valid config)")
     p.add_argument("--check-finite", type=int, default=0,
                    help="every N steps, verify all fields are finite and "
                         "abort with the failing step range if not (debug "

@@ -227,6 +227,12 @@ def _pick_tiles(Z: int, Y: int, X: int, margin: int, itemsize: int,
     """Choose (bz, by) dividing (Z, Y), multiples of 2*margin, fitting VMEM."""
     if (2 * margin) % 8:
         return None  # y-tail blocks must be sublane-aligned
+    # Sub-f32 dtypes: budget as if f32, capping tiles at the f32 picks.
+    # The larger windows that bf16's halved bytes would admit hang the
+    # Mosaic compile at 512^3 (>20 min, results_r03.json
+    # heat3d_512_bf16_fused4); the f32-shaped tiles are the proven
+    # envelope.  Revisit with a tile bisect (docs/STATE.md).
+    itemsize = max(itemsize, 4)
     best = None
     for bz in (64, 32, 16, 8):
         for by in (64, 32, 16, 8):

@@ -297,3 +297,72 @@ def test_sharded_width_k_halo(halo):
 )
 def test_sharded_width_k_halo_property_wide(halo, mesh_i, local, seed):
     _check_width_k(halo, mesh_i, local, seed)
+
+
+# ---------------------------------------------------------------------------
+# Pallas whole-step builders (rawstep / fused) over free shapes
+# ---------------------------------------------------------------------------
+
+_PALLAS_CASES = [
+    ("heat3d", {}), ("heat3d27", {"alpha": 0.1}), ("wave3d", {}),
+]
+
+
+@pytest.mark.slow
+@settings(max_examples=8, **_SETTINGS)
+@given(
+    case=hs.sampled_from(_PALLAS_CASES),
+    z=hs.integers(4, 40),
+    y=hs.integers(4, 40),
+    x=hs.sampled_from([8, 17, 128, 130]),
+    seed=hs.integers(0, 2**16),
+)
+def test_raw_step_property(case, z, y, x, seed):
+    """make_raw_step either declines or matches make_step, any shape."""
+    from mpi_cuda_process_tpu.ops.pallas import rawstep
+
+    name, kw = case
+    st = make_stencil(name, **kw)
+    grid = (z, y, x)
+    raw = rawstep.make_raw_step(st, grid, interpret=True)
+    if raw is None:
+        return  # untileable is a valid answer; never a crash
+    fields = init_state(st, grid, seed=seed, density=0.3, kind="auto")
+    ref = make_step(st, grid)(fields)
+    got = raw(fields)
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(r, np.float32),
+            rtol=0, atol=1e-3)
+
+
+@pytest.mark.slow
+@settings(max_examples=6, **_SETTINGS)
+@given(
+    case=hs.sampled_from(_PALLAS_CASES),
+    z=hs.sampled_from([8, 16, 24, 40]),
+    y=hs.sampled_from([8, 16, 32]),
+    x=hs.sampled_from([64, 128]),
+    k=hs.sampled_from([4, 8]),
+    seed=hs.integers(0, 2**16),
+)
+def test_fused_step_property(case, z, y, x, k, seed):
+    """make_fused_step either declines or matches k plain steps."""
+    from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
+
+    name, kw = case
+    st = make_stencil(name, **kw)
+    grid = (z, y, x)
+    fused = make_fused_step(st, grid, k, interpret=True)
+    if fused is None:
+        return
+    fields = init_state(st, grid, seed=seed, density=0.3, kind="auto")
+    ref = fields
+    step = make_step(st, grid)
+    for _ in range(k):
+        ref = step(ref)
+    got = fused(fields)
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(r, np.float32),
+            rtol=0, atol=1e-3)
