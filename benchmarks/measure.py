@@ -133,12 +133,17 @@ CONFIGS = [
      "fused4"),
     ("wave3d_512_f32_fused4", "wave3d", (512, 512, 512), 8, "float32",
      "fused4"),
-    # 1024^3 bf16: 2.1 GiB/buffer — the largest-grid single-chip point
-    # (VERDICT item 3); jnp vs raw vs fused
+    # 1024^3: the largest single-chip grids (bf16 2.1 GiB / f32 4.3 GiB per
+    # buffer — the closest single-chip proxy for the 4096^3 north star);
+    # jnp vs raw vs fused
     ("heat3d_1024_bf16", "heat3d", (1024, 1024, 1024), 8, "bfloat16", "jnp"),
     ("heat3d_1024_bf16_raw", "heat3d", (1024, 1024, 1024), 8, "bfloat16",
      "raw"),
     ("heat3d_1024_bf16_fused4", "heat3d", (1024, 1024, 1024), 4, "bfloat16",
+     "fused4"),
+    ("heat3d_1024_f32_raw", "heat3d", (1024, 1024, 1024), 6, "float32",
+     "raw"),
+    ("heat3d_1024_f32_fused4", "heat3d", (1024, 1024, 1024), 4, "float32",
      "fused4"),
     # jnp references for the 27-point / 13-point / wave families
     ("heat3d27_256_f32_jnp", "heat3d27", (256, 256, 256), 50, "float32", "jnp"),

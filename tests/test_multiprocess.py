@@ -46,6 +46,21 @@ total = int(jax.numpy.sum(out[0]))  # replicated global reduction
 pop0 = int(jax.numpy.sum(init_state_sharded(
     st, grid, mesh, seed=7, density=0.3, kind="random")[0]))
 print(f"RESULT rank={{rank}} pop0={{pop0}} total={{total}}", flush=True)
+
+# Second leg: temporal blocking UNDER the cross-process decomposition —
+# k fused Pallas micro-steps (interpret mode on CPU) per width-k exchange,
+# the width-k ppermute slabs now crossing the process boundary over DCN.
+from mpi_cuda_process_tpu.parallel.stepper import make_sharded_fused_step
+
+st2 = make_stencil("heat3d")
+grid2 = (16, 8, 128)
+mesh2 = make_mesh((2, 1, 1))
+f2 = init_state_sharded(st2, grid2, mesh2, seed=3, density=0.3, kind="pulse")
+fused = make_sharded_fused_step(st2, mesh2, grid2, k=4, interpret=True)
+assert fused is not None
+out2 = make_runner(fused, 1)(f2)
+fsum = float(jax.numpy.sum(out2[0].astype(jax.numpy.float64)))
+print(f"FUSED rank={{rank}} fsum={{fsum:.6f}}", flush=True)
 """
 
 
@@ -131,11 +146,15 @@ def test_two_process_distributed_matches_single():
         outs.append(out)
 
     results = {}
+    fused = {}
     for out in outs:
         for line in out.splitlines():
             if line.startswith("RESULT"):
                 kv = dict(p.split("=") for p in line.split()[1:])
                 results[int(kv["rank"])] = (int(kv["pop0"]), int(kv["total"]))
+            elif line.startswith("FUSED"):
+                kv = dict(p.split("=") for p in line.split()[1:])
+                fused[int(kv["rank"])] = float(kv["fsum"])
     assert set(results) == {0, 1}
     # both processes must agree on the global state
     assert results[0] == results[1]
@@ -150,3 +169,15 @@ def test_two_process_distributed_matches_single():
     ref = make_runner(make_step(st, (16, 16)), 5)(fields)
     total_ref = int(np.asarray(ref[0]).sum())
     assert results[0] == (pop0_ref, total_ref)
+
+    # fused leg: cross-process sharded fused == 4 plain single-process steps
+    assert set(fused) == {0, 1}
+    assert fused[0] == fused[1]
+    st2 = make_stencil("heat3d")
+    f2 = init_state(st2, (16, 8, 128), seed=3, density=0.3, kind="pulse")
+    r2 = make_runner(make_step(st2, (16, 8, 128)), 4)(f2)
+    ref_sum = float(np.asarray(r2[0], np.float64).sum())
+    # f32 state summed over 16k cells: compare relatively (few-ULP FMA
+    # differences between the fused and plain graphs scale with the sum)
+    assert abs(fused[0] - ref_sum) < 1e-5 * max(1.0, abs(ref_sum)), (
+        fused[0], ref_sum)
