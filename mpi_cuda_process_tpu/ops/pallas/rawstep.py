@@ -71,14 +71,19 @@ def _roll2(x, dy, dx, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _taps7(alpha, interpret, s, bz):
+def _slab_lap7(s, bz, interpret):
+    """(interior planes, 7-point Laplacian) of a (bz+2, Y, X) slab."""
     u = s[1:bz + 1]
-    lap = (
+    return u, (
         s[0:bz] + s[2:bz + 2]
         + _roll(u, 1, 1, interpret) + _roll(u, -1, 1, interpret)
         + _roll(u, 1, 2, interpret) + _roll(u, -1, 2, interpret)
         - 6.0 * u
     )
+
+
+def _taps7(alpha, interpret, s, bz):
+    u, lap = _slab_lap7(s, bz, interpret)
     return u + alpha * lap
 
 
@@ -120,16 +125,25 @@ def _taps13(alpha, interpret, s, bz):
     return u + alpha * acc
 
 
-# (taps_fn, halo, live-factor): scoped-VMEM use is ~live_factor * bz *
-# plane_bytes (pipeline buffers + slab + live tap intermediates).  Factors
-# are fit to the measured compile envelope on the real v5e (round 3):
-# 7-pt compiles at bz=16 for 512^3 planes, 13-pt at bz=8, etc.  Throughput
-# is flat across compiling bz (the Mosaic DMA pipeline, not compute, is the
-# bound), so the pick only has to stay inside the envelope.
+# Single-field stencils: name -> (taps factory, halo, live-factor).  The
+# factory maps (stencil, interpret) to a slab-taps fn (s, bz) -> un-pinned
+# update of the middle bz planes; the shared builder supplies specs, frame
+# pinning, and the pallas_call.  live-factor: scoped-VMEM use is
+# ~live_factor * bz * plane_bytes (pipeline buffers + slab + live tap
+# intermediates), fit to the measured compile envelope on the real v5e
+# (round 3): 7-pt compiles at bz=16 for 512^3 planes, 13-pt at bz=8, etc.
+# Throughput is flat across compiling bz (the Mosaic DMA pipeline, not
+# compute, is the bound), so the pick only has to stay inside the envelope.
 _TAPS = {
-    "heat3d": (_taps7, 1, 5),
-    "heat3d27": (_taps27, 1, 8),
-    "heat3d4th": (_taps13, 2, 6),
+    "heat3d": (lambda st, i: functools.partial(
+        _taps7, float(st.params["alpha"]), i), 1, 5),
+    "heat3d27": (lambda st, i: functools.partial(
+        _taps27, float(st.params["alpha"]), i), 1, 8),
+    "heat3d4th": (lambda st, i: functools.partial(
+        _taps13, float(st.params["alpha"]), i), 2, 6),
+    "advect3d": (lambda st, i: functools.partial(
+        _taps_advect, tuple(float(c) for c in st.params["courant"]), i),
+        1, 6),
 }
 
 
@@ -158,17 +172,46 @@ def _heat_kernel(taps, bz, halo, shape, prev_p, cur, next_p, out):
 def _wave_kernel(c2dt2, bz, shape, interpret, prev_p, cur, next_p, uprev,
                  out):
     s = jnp.concatenate([prev_p[...], cur[...], next_p[...]], axis=0)
-    u = s[1:bz + 1]
-    lap = (
-        s[0:bz] + s[2:bz + 2]
-        + _roll(u, 1, 1, interpret) + _roll(u, -1, 1, interpret)
-        + _roll(u, 1, 2, interpret) + _roll(u, -1, 2, interpret)
-        - 6.0 * u
-    )
+    u, lap = _slab_lap7(s, bz, interpret)
     new = 2.0 * u - uprev[...] + c2dt2 * lap
     frame = _frame_mask_chunk(bz, 1, shape, u)
     # frame keeps old u: by induction it still holds the Dirichlet value
     out[...] = jnp.where(frame, u, new)
+
+
+def _taps_advect(courant, interpret, s, bz):
+    # First-order upwind: each axis reads only its upstream neighbor
+    # (ops/advection.py) — z taps from the slab planes, y/x taps as rolls.
+    u = s[1:bz + 1]
+    acc = u
+    cz, cy, cx = courant
+    if cz > 0:
+        acc = acc - cz * (u - s[0:bz])
+    elif cz < 0:
+        acc = acc - cz * (s[2:bz + 2] - u)
+    for c, axis in ((cy, 1), (cx, 2)):
+        if c > 0:
+            acc = acc - c * (u - _roll(u, 1, axis, interpret))
+        elif c < 0:
+            acc = acc - c * (_roll(u, -1, axis, interpret) - u)
+    return acc
+
+
+def _grayscott_kernel(du, dv, f, kappa, bz, shape, interpret,
+                      uprev_p, ucur, unext_p, vprev_p, vcur, vnext_p,
+                      out_u, out_v):
+    # Two coupled diffusing fields (ops/reaction.py): both carry footprints,
+    # so both arrive as halo'd slabs and both outputs are frame-pinned.
+    su = jnp.concatenate([uprev_p[...], ucur[...], unext_p[...]], axis=0)
+    sv = jnp.concatenate([vprev_p[...], vcur[...], vnext_p[...]], axis=0)
+    u, lap_u = _slab_lap7(su, bz, interpret)
+    v, lap_v = _slab_lap7(sv, bz, interpret)
+    uvv = u * v * v
+    new_u = u + du * lap_u - uvv + f * (1.0 - u)
+    new_v = v + dv * lap_v + uvv - (f + kappa) * v
+    frame = _frame_mask_chunk(bz, 1, shape, u)
+    out_u[...] = jnp.where(frame, u, new_u)
+    out_v[...] = jnp.where(frame, v, new_v)
 
 
 def _pick_bz(Z: int, plane_bytes: int, halo: int, live_factor: int) -> int:
@@ -199,7 +242,8 @@ def _zspecs(Z, Y, X, bz, halo):
 
 
 def raw_step_supported(stencil: Stencil) -> bool:
-    return stencil.name in _TAPS or stencil.name == "wave3d"
+    return stencil.name in _TAPS or stencil.name in (
+        "wave3d", "grayscott3d")
 
 
 def make_raw_step(
@@ -249,16 +293,42 @@ def make_raw_step(
 
         return step
 
+    if stencil.name == "grayscott3d":
+        halo = 1
+        # two full slab sets + two outputs live at once
+        bz = _pick_bz(Z, plane, halo, live_factor=14)
+        if bz == 0 or Z <= 2 * halo:
+            return None
+        prev_p, cur, next_p = _zspecs(Z, Y, X, bz, halo)
+        out = pl.BlockSpec((bz, Y, X), lambda i: (i, 0, 0))
+        p = stencil.params
+        call = pl.pallas_call(
+            functools.partial(
+                _grayscott_kernel, float(p["du"]), float(p["dv"]),
+                float(p["f"]), float(p["kappa"]), bz, (Z, Y, X), interpret),
+            grid=(Z // bz,),
+            in_specs=[prev_p, cur, next_p, prev_p, cur, next_p],
+            out_specs=[out, out],
+            out_shape=[jax.ShapeDtypeStruct((Z, Y, X), stencil.dtype)] * 2,
+            interpret=interpret,
+            compiler_params=None if interpret else _COMPILER_PARAMS,
+        )
+
+        def step(fields: Fields) -> Fields:
+            u, v = fields
+            return tuple(call(u, u, u, v, v, v))
+
+        return step
+
     if stencil.name not in _TAPS:
         return None
-    taps_fn, halo, live = _TAPS[stencil.name]
+    taps_factory, halo, live = _TAPS[stencil.name]
     if Z <= 2 * halo:
         return None
     bz = _pick_bz(Z, plane, halo, live_factor=live)
     if bz == 0:
         return None
-    alpha = float(stencil.params["alpha"])
-    taps = functools.partial(taps_fn, alpha, interpret)
+    taps = taps_factory(stencil, interpret)
     prev_p, cur, next_p = _zspecs(Z, Y, X, bz, halo)
     out = pl.BlockSpec((bz, Y, X), lambda i: (i, 0, 0))
     call = pl.pallas_call(

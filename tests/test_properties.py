@@ -305,6 +305,7 @@ def test_sharded_width_k_halo_property_wide(halo, mesh_i, local, seed):
 
 _PALLAS_CASES = [
     ("heat3d", {}), ("heat3d27", {"alpha": 0.1}), ("wave3d", {}),
+    ("grayscott3d", {}), ("advect3d", {"cx": 0.3, "cy": -0.2, "cz": 0.2}),
 ]
 
 

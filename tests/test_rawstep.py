@@ -24,6 +24,8 @@ CASES = [
     ("heat3d27", (16, 12, 14), {}),
     ("heat3d4th", (16, 14, 130), {}),
     ("wave3d", (16, 18, 12), {}),
+    ("advect3d", (16, 10, 12), {"cx": 0.3, "cy": -0.2, "cz": 0.25}),
+    ("grayscott3d", (16, 12, 130), {}),
 ]
 
 
