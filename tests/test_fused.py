@@ -112,12 +112,17 @@ def test_unsupported_configs_return_none():
 # sharded + fused composition: k fused steps per width-k*halo exchange
 # ---------------------------------------------------------------------------
 
+# heat3d covers the composition in the default tier; the 27-point and
+# two-field variants re-compile the heaviest shard_map+interpret programs
+# (~30s each on CPU) and ride the slow tier.
 @pytest.mark.parametrize(
     "name,grid,mesh_shape,k,kw",
     [
         ("heat3d", (16, 16, 128), (2, 2, 1), 4, {}),
-        ("heat3d27", (16, 16, 128), (2, 1, 1), 4, {"alpha": 0.1}),
-        ("wave3d", (32, 16, 128), (2, 2, 1), 4, {}),
+        pytest.param("heat3d27", (16, 16, 128), (2, 1, 1), 4,
+                     {"alpha": 0.1}, marks=pytest.mark.slow),
+        pytest.param("wave3d", (32, 16, 128), (2, 2, 1), 4, {},
+                     marks=pytest.mark.slow),
     ],
 )
 def test_sharded_fused_matches_unsharded(name, grid, mesh_shape, k, kw):
