@@ -145,6 +145,15 @@ CONFIGS = [
      "raw"),
     ("heat3d_1024_f32_fused4", "heat3d", (1024, 1024, 1024), 4, "float32",
      "fused4"),
+    # transport + reaction families: raw kernel vs jnp
+    ("advect3d_256_f32_jnp", "advect3d", (256, 256, 256), 50, "float32",
+     "jnp"),
+    ("advect3d_256_f32_raw", "advect3d", (256, 256, 256), 50, "float32",
+     "raw"),
+    ("grayscott3d_256_f32_jnp", "grayscott3d", (256, 256, 256), 30,
+     "float32", "jnp"),
+    ("grayscott3d_256_f32_raw", "grayscott3d", (256, 256, 256), 30,
+     "float32", "raw"),
     # jnp references for the 27-point / 13-point / wave families
     ("heat3d27_256_f32_jnp", "heat3d27", (256, 256, 256), 50, "float32", "jnp"),
     ("heat3d4th_256_f32_jnp", "heat3d4th", (256, 256, 256), 50, "float32",
