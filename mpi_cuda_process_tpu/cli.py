@@ -173,9 +173,10 @@ def maybe_auto_fuse(cfg: RunConfig) -> RunConfig:
         return cfg
     if jax.default_backend() != "tpu":
         return cfg
-    # f32 only: the bf16 512^3 fused compile HANGS (>20 min — recorded in
-    # results_r03.json), and a hang is the one failure the jnp fallback
-    # cannot catch.  Lift after the bf16 tile bisect (docs/STATE.md).
+    # f32 only for now: bf16's sublane tile (16) makes k=4 untileable
+    # (fused._sublane) — bf16 needs k=8, which is pending a measured win
+    # on the real chip (heat3d_*_bf16_fused8 in benchmarks/measure.py)
+    # before auto selects it.
     params = dict(cfg.params)
     dtype = jnp.dtype(cfg.dtype) if cfg.dtype else params.get("dtype")
     if dtype is not None and jnp.dtype(dtype) != jnp.float32:
@@ -329,8 +330,9 @@ def build(cfg: RunConfig):
             if fused is None:
                 raise ValueError(
                     f"--fuse {cfg.fuse} unsupported for {st.name} on grid "
-                    f"{cfg.grid} (need a fused kernel, 2*k*halo % 8 == 0, "
-                    f"and an aligned tiling)")
+                    f"{cfg.grid} (need a fused kernel, 2*k*halo a multiple "
+                    f"of the dtype's sublane tile — 8 for f32, 16 for bf16 "
+                    f"— and an aligned tiling)")
         if resuming:
             fields, start_step = _resume(cfg, fields)
         # fused step_fn advances cfg.fuse steps per call; run() accounts.

@@ -24,10 +24,11 @@ Layout choices that matter on TPU:
     walls, which the per-micro-step frame mask re-pins anyway.  This keeps
     every VMEM buffer at exactly X lanes (no 264->384 lane-rounding waste) and
     avoids unaligned lane concatenation, which Mosaic cannot lower.
-  * The window is assembled from four (8,128)-aligned blocks of the z/y-padded
-    input (core, y-tail, z-tail, corner) — overlapping BlockSpecs must start
-    on block-aligned offsets, hence the ``bz % 2k == by % 2k == 0`` and
-    ``2k % 8 == 0`` tiling constraints.
+  * The window is assembled from four sublane-tile-aligned blocks of the
+    z/y-padded input (core, y-tail, z-tail, corner) — overlapping BlockSpecs
+    must start on block-aligned offsets, hence ``bz % 2m == by % 2m == 0``
+    and ``2m`` (m = k*halo) a multiple of the DTYPE's sublane tile
+    (``_sublane``: 8 for f32, 16 for bf16 — so bf16 halo-1 needs k >= 8).
 
 Operates on the RAW grid (guard frame included, no halo pre-padding), so it is
 a whole-step replacement (``fields -> fields after k steps``) rather than a
@@ -159,12 +160,33 @@ def _micro_wave3d(stencil, interpret):
     return micro
 
 
+def _micro_grayscott3d(stencil, interpret):
+    # Two coupled diffusing fields, BOTH with footprints (unlike wave3d's
+    # neighbor-free carry) — the jnp path pays 4 HBM arrays per step and
+    # measured 14.4 Gcells/s at 256^3 (results_r03.json); fusing k steps
+    # amortizes all of it.
+    du = float(stencil.params["du"])
+    dv = float(stencil.params["dv"])
+    f = float(stencil.params["f"])
+    kappa = float(stencil.params["kappa"])
+
+    def micro(fields, frame):
+        u, v = fields
+        uvv = u * v * v
+        new_u = u + du * _lap7(u, interpret) - uvv + f * (1.0 - u)
+        new_v = v + dv * _lap7(v, interpret) + uvv - (f + kappa) * v
+        return (jnp.where(frame, u, new_u), jnp.where(frame, v, new_v))
+
+    return micro
+
+
 # name -> (micro factory, halo, carried fields)
 _MICRO = {
     "heat3d": (_micro_heat3d, 1, 1),
     "heat3d27": (_micro_heat3d27, 1, 1),
     "heat3d4th": (_micro_heat3d4th, 2, 1),
     "wave3d": (_micro_wave3d, 1, 2),
+    "grayscott3d": (_micro_grayscott3d, 1, 2),
 }
 
 
@@ -222,16 +244,25 @@ def _lane_round(n: int) -> int:
     return -(-n // 128) * 128
 
 
+def _sublane(itemsize: int) -> int:
+    """TPU second-minor tile size: (8,128) f32, (16,128) bf16, (32,128) i8."""
+    return 8 * max(1, 4 // itemsize)
+
+
 def _pick_tiles(Z: int, Y: int, X: int, margin: int, itemsize: int,
                 nfields: int):
     """Choose (bz, by) dividing (Z, Y), multiples of 2*margin, fitting VMEM."""
-    if (2 * margin) % 8:
-        return None  # y-tail blocks must be sublane-aligned
-    # Sub-f32 dtypes: budget as if f32, capping tiles at the f32 picks.
-    # The larger windows that bf16's halved bytes would admit hang the
-    # Mosaic compile at 512^3 (>20 min, results_r03.json
-    # heat3d_512_bf16_fused4); the f32-shaped tiles are the proven
-    # envelope.  Revisit with a tile bisect (docs/STATE.md).
+    if (2 * margin) % _sublane(itemsize):
+        # Tail blocks are (2m, by, X) / (bz, 2m, X) at offsets that are
+        # multiples of 2m: both their size and their origin must be
+        # sublane-tile-aligned FOR THE DTYPE.  f32 needs 2m % 8; bf16 needs
+        # 2m % 16 (so k=8 for halo-1 stencils, not k=4 — the round-3 bf16
+        # 512^3 "hang"/HTTP-500 was a misaligned-bf16-window Mosaic compile,
+        # results_r03.json heat3d_512_bf16_fused4).
+        return None
+    # Sub-f32 dtypes: budget as if f32, capping tiles at the f32 picks —
+    # the proven envelope.  Revisit the halved-bytes headroom with a tile
+    # bisect once a bf16 fused config has a measured win (docs/STATE.md).
     itemsize = max(itemsize, 4)
     best = None
     for bz in (64, 32, 16, 8):
@@ -338,8 +369,9 @@ def make_fused_step(
     the same stencil/shape (guard-frame semantics included) — asserted by
     tests/test_fused.py.  Returns None when the shape/k cannot be tiled
     (callers fall back to the per-step path).  ``2 * k * halo`` must be a
-    multiple of 8 (sublane alignment of the tail blocks), i.e. k in
-    {4, 8, ...} for halo-1 stencils and {2, 4, ...} for halo-2.
+    multiple of the dtype's sublane tile (8 for f32, 16 for bf16 — see
+    ``_sublane``), i.e. f32 halo-1 needs k in {4, 8, ...}, bf16 halo-1
+    needs k in {8, 16, ...}.
     """
     built = build_fused_call(
         stencil, tuple(int(s) for s in global_shape), k, tiles, interpret)

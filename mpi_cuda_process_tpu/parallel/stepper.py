@@ -240,7 +240,8 @@ def make_sharded_fused_step(
       * the lane axis x (grid axis 2) unsharded — the kernel's x taps are
         lane rolls of full rows;
       * local z/y extents tileable per ``_pick_tiles`` (multiples of
-        ``2*k*halo`` >= 8).
+        ``2*k*halo``, itself a multiple of the dtype's sublane tile —
+        8 for f32, 16 for bf16: see ``fused._sublane``).
 
     Every field is exchanged at width ``k*halo`` regardless of
     ``field_halos`` — temporal blocking consumes spatial margin for ALL

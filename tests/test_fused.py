@@ -45,6 +45,7 @@ def test_fused_matches_plain_steps(shape, k):
         ("heat3d27", (16, 16, 128), 4, {"alpha": 0.1}),
         ("heat3d4th", (16, 16, 128), 2, {}),   # halo 2: margin 4, 2m=8
         ("wave3d", (16, 16, 128), 4, {}),      # two-field leapfrog carry
+        ("grayscott3d", (16, 16, 128), 4, {}),  # both fields halo'd
     ],
 )
 def test_fused_families_match_plain_steps(name, shape, k, kw):

@@ -45,13 +45,15 @@ def _compare(name, grid, mesh_shape, steps=5, periodic=False, **params):
 
 
 # Mesh ladders are deliberately minimal: every fresh (stencil, mesh) pair
-# costs a shard_map compile (~25s on the 8-virtual-device CPU backend), and
-# round 2's full ladder put this file alone past a 10-minute CI budget.
-# Coverage kept: 1-D row split, 2-D, asymmetric 2-D (life); 3-D and
-# asymmetric 3-D (heat3d); corner exchange (heat27); halo-2 + carry
-# (test_overlap.py); free-shape meshes (test_properties.py wide tier).
+# costs a shard_map compile (~25-70s on the 8-virtual-device CPU backend),
+# and round 2's full ladder put this file alone past a 10-minute CI budget.
+# Default tier keeps ONE mesh per invariant: 2-D bit-exact (life), 2-D float
+# (heat2d), 3-axis (heat3d), corner exchange (heat27), halo-2 (heat4th),
+# two-field carry (wave).  1-D, asymmetric, and extra-axis variants are slow
+# tier; free-shape meshes live in test_properties.py's wide tier.
 @pytest.mark.parametrize("mesh_shape", [
-    (2,), (2, 2),
+    (2, 2),  # both axes split + corner traffic, bit-exact int path
+    pytest.param((2,), marks=pytest.mark.slow),    # 1-D row split
     pytest.param((4, 2), marks=pytest.mark.slow),  # asymmetric 2-D
 ])
 def test_life_sharded_bitexact(mesh_shape):
@@ -63,7 +65,12 @@ def test_heat2d_sharded(mesh_shape):
     _compare("heat2d", (16, 16), mesh_shape)
 
 
-@pytest.mark.parametrize("mesh_shape", [(2, 2, 2), (1, 2, 4)])
+@pytest.mark.parametrize("mesh_shape", [
+    (2, 2, 2),
+    # asymmetric + unsharded axis: also exercised by the sharded-fused tests
+    # and the dryrun's (z, y, 1) mesh — slow tier here
+    pytest.param((1, 2, 4), marks=pytest.mark.slow),
+])
 def test_heat3d_sharded(mesh_shape):
     _compare("heat3d", (8, 8, 8), mesh_shape)
 
@@ -74,9 +81,9 @@ def test_heat27_sharded_corners(mesh_shape):
     _compare("heat3d27", (8, 8, 8), mesh_shape, alpha=0.1)
 
 
-@pytest.mark.parametrize("mesh_shape", [(2, 2)])
-def test_wave_sharded(mesh_shape):
-    _compare("wave3d", (8, 8, 8), mesh_shape, c2dt2=0.1)
+# (No separate plain wave3d sharded test: test_wave_skips_uprev_exchange_
+# below runs the identical (2, 2)-mesh comparison plus the field_halos
+# assertion — one shard_map compile instead of two.)
 
 
 def test_nondivisible_grid_rejected():
@@ -143,7 +150,13 @@ def test_wave_skips_uprev_exchange_but_stays_correct():
     _compare("wave3d", (8, 8, 8), (2, 2), c2dt2=0.1)
 
 
-@pytest.mark.parametrize("mesh_shape", [(2,), (2, 2), (2, 2, 2)])
+@pytest.mark.parametrize("mesh_shape", [
+    (2, 2),
+    # 1-D and 3-axis halo-2 variants: the width-k exchange is additionally
+    # covered mesh-free by test_properties.test_sharded_width_k_halo
+    pytest.param((2,), marks=pytest.mark.slow),
+    pytest.param((2, 2, 2), marks=pytest.mark.slow),
+])
 def test_heat4th_halo2_sharded(mesh_shape):
     """Width-2 halo slabs across shard boundaries (k>1 exchange path)."""
     _compare("heat3d4th", (8, 8, 8), mesh_shape, alpha=0.05)
