@@ -137,14 +137,20 @@ def config_from_args(argv=None) -> RunConfig:
 # the whole-step raw Pallas kernels (ops/pallas/rawstep.py) beat XLA's
 # fusion for these stencils at every size — and for heat3d only in the
 # large-grid regime where XLA's pad+update fusion collapses (17.6 Gcells/s
-# at 512^3 vs 85 at 256^3; the raw kernel holds ~40).
+# at 512^3 vs 85 at 256^3; the raw kernel holds ~40).  The raw kernel is
+# ALSO the fallback for the fused families below when the run's cadences
+# or shape rule temporal blocking out.
 _RAW_WINS = {"heat3d27", "heat3d4th", "wave3d"}
 _CLIFF_CELLS = 100_000_000  # heat3d: jnp wins below, raw kernel above
 
-# Transparent temporal blocking (ops/pallas/fused.py): k=4 measured ~107
-# Gcells/s at BOTH 256^3 and 512^3 f32 (results_r03.json) — the fastest
-# heat3d path at every size.  Auto-applied when step accounting allows it.
-_AUTO_FUSE_K = 4
+# Transparent temporal blocking (ops/pallas/fused.py), k steps per HBM
+# pass: the fastest measured path at every size for these families
+# (results_r03.json, f32 Gcells/s fused vs best-other):
+#   heat3d    107.0 / 107.3  vs jnp  86.3 (256^3) /  17.6 (512^3)
+#   heat3d27   50.4 /  47.8  vs raw  37.6         /  38.5
+#   wave3d     70.0 /  71.1  vs raw  23.9         /  23.8
+# Auto-applied when step accounting allows it (maybe_auto_fuse).
+_AUTO_FUSE_K = {"heat3d": 4, "heat3d27": 4, "wave3d": 4}
 
 
 def _uses_mesh(cfg: RunConfig) -> bool:
@@ -160,8 +166,9 @@ def _make_cfg_stencil(cfg: RunConfig):
 
 
 def maybe_auto_fuse(cfg: RunConfig) -> RunConfig:
-    """Upgrade an eligible ``--compute auto`` heat3d run to ``--fuse 4``.
+    """Upgrade an eligible ``--compute auto`` run to ``--fuse k``.
 
+    Applies to the measured fused-kernel winners (``_AUTO_FUSE_K``).
     Bit-for-bit: k fused steps == k plain steps (tests/test_fused.py), so
     this is purely an execution-strategy choice.  Only taken when every
     cadence (iters, log/checkpoint/dump/check-finite intervals) is a
@@ -169,9 +176,10 @@ def maybe_auto_fuse(cfg: RunConfig) -> RunConfig:
     grid is tileable; a compile failure on the real chip is caught by
     ``run``'s auto-retry, which re-runs the whole config on the jnp path.
     """
-    if cfg.compute != "auto" or cfg.fuse or cfg.stencil != "heat3d":
+    if cfg.compute != "auto" or cfg.fuse:
         return cfg
-    if jax.default_backend() != "tpu":
+    k = _AUTO_FUSE_K.get(cfg.stencil)
+    if k is None or jax.default_backend() != "tpu":
         return cfg
     # f32 only for now: bf16's sublane tile (16) makes k=4 untileable
     # (fused._sublane) — bf16 needs k=8, which is pending a measured win
@@ -184,7 +192,6 @@ def maybe_auto_fuse(cfg: RunConfig) -> RunConfig:
     if (cfg.periodic or cfg.tol > 0 or cfg.debug_checks or cfg.ensemble
             or cfg.overlap or cfg.resume or _uses_mesh(cfg) or cfg.mesh):
         return cfg
-    k = _AUTO_FUSE_K
     cadences = [cfg.iters, cfg.log_every, cfg.checkpoint_every,
                 cfg.check_finite, cfg.dump_every]
     if any(v % k for v in cadences if v):

@@ -39,7 +39,7 @@ def scaling():
 
 
 def test_bench_stencil_smoke(bench):
-    mcells, per_step, compute = bench.bench_stencil(
+    mcells, per_step, compute, _suspect = bench.bench_stencil(
         "heat3d", (16, 16, 16), {}, 2, reps=1)
     assert math.isfinite(mcells) and mcells > 0
     assert math.isfinite(per_step) and per_step > 0
@@ -48,7 +48,7 @@ def test_bench_stencil_smoke(bench):
 
 def test_bench_stencil_fused_accounting(bench):
     # fused path must report per REAL step (k steps per fused call)
-    mcells, per_step, compute = bench.bench_stencil(
+    mcells, per_step, compute, _suspect = bench.bench_stencil(
         "heat3d", (32, 32, 128), {}, 2, reps=1, fuse=4)
     assert compute in ("jnp", "pallas_fused_k4")  # jnp if untileable
     assert math.isfinite(mcells) and mcells > 0

@@ -282,3 +282,30 @@ def test_dump_every_writes_snapshots(tmp_path):
         "step_00000004.npy", "step_00000008.npy"]
     a = np.load(os.path.join(d, files[0]))
     assert a.shape == (16, 16)
+
+
+def test_auto_fuse_policy_table(monkeypatch):
+    """maybe_auto_fuse upgrades exactly the measured fused winners on TPU."""
+    from mpi_cuda_process_tpu import cli
+    from mpi_cuda_process_tpu.ops.pallas import fused
+
+    # Patching the shared jax module makes _interpret_default() think it is
+    # on TPU too — pin interpret mode explicitly (in fused's namespace,
+    # where the name is bound) so the tileability probe never constructs a
+    # real TPU pallas_call on the CPU test backend.
+    monkeypatch.setattr(cli.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(fused, "_interpret_default", lambda: True)
+    base = dict(grid=(16, 16, 128), iters=8)
+    # winners upgrade (the builder still validates tileability)
+    for name in ("heat3d", "heat3d27", "wave3d"):
+        assert cli.maybe_auto_fuse(RunConfig(stencil=name, **base)).fuse == 4
+    # non-winners and explicit modes never upgrade
+    assert cli.maybe_auto_fuse(RunConfig(stencil="advect3d", **base)).fuse == 0
+    assert cli.maybe_auto_fuse(
+        RunConfig(stencil="heat3d", compute="jnp", **base)).fuse == 0
+    # bf16 gated until the k=8 win is measured on the real chip
+    assert cli.maybe_auto_fuse(
+        RunConfig(stencil="heat3d", dtype="bfloat16", **base)).fuse == 0
+    # cadence misalignment blocks the upgrade
+    assert cli.maybe_auto_fuse(
+        RunConfig(stencil="heat3d", grid=(16, 16, 128), iters=6)).fuse == 0
