@@ -99,7 +99,9 @@ def _time_scan(step, mk, grid, steps, reps, step_unit):
         return b
 
     t_a, t_b = best(run_a), best(run_b)
-    if t_b - t_a <= 0.05 * t_a:
+    from bench import NOISE_FLOOR_FRAC  # repo root is on sys.path (top)
+
+    if t_b - t_a <= NOISE_FLOOR_FRAC * t_a:
         # t(4N) - t(N) should be ~3x t(N)'s step content; a non-positive or
         # tiny-relative delta means noise swamped the signal: report, don't
         # fabricate a plausible-looking Mcells/s from a clamped epsilon.

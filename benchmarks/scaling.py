@@ -76,7 +76,8 @@ def _time_run(run, fields, reps: int) -> float:
     return best
 
 
-def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False):
+def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
+                 fuse=0):
     import jax
 
     from mpi_cuda_process_tpu import (
@@ -85,9 +86,29 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False):
     from mpi_cuda_process_tpu.driver import make_runner
 
     n_dev = math.prod(mesh_shape)
+    step_unit = 1
     if n_dev > 1:
         mesh = make_mesh(mesh_shape)
-        step = make_sharded_step(st, mesh, global_shape, overlap=overlap)
+        if fuse > 1:
+            # temporal blocking UNDER decomposition: k micro-steps per
+            # width-k exchange — the 4096^3-class execution strategy
+            from mpi_cuda_process_tpu.parallel.stepper import (
+                make_sharded_fused_step,
+            )
+
+            step = make_sharded_fused_step(st, mesh, global_shape, fuse)
+            if step is None:
+                return None
+            step_unit = fuse
+        else:
+            step = make_sharded_step(st, mesh, global_shape, overlap=overlap)
+    elif fuse > 1:
+        from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
+
+        step = make_fused_step(st, global_shape, fuse)
+        if step is None:
+            return None
+        step_unit = fuse
     else:
         step = make_step(st, global_shape)
     fields = init_state(st, global_shape, kind="auto")
@@ -101,7 +122,7 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False):
     float(jnp.sum(run(fields)[0]))  # compile + warm
     t = _time_run(run, fields, reps)
     cells = math.prod(global_shape)
-    return cells * steps / t / 1e6, t / steps
+    return cells * steps * step_unit / t / 1e6, t / (steps * step_unit)
 
 
 def bench_halo_overhead(st, mesh_shape, global_shape, steps, reps=3):
@@ -168,6 +189,11 @@ def main(argv=None) -> int:
                    help="use the explicit interior/boundary overlap stepper "
                         "(weak/strong modes) — compare against the default "
                         "XLA-scheduled exchange")
+    p.add_argument("--fuse", type=int, default=0,
+                   help="temporal blocking: k fused micro-steps per "
+                        "width-k exchange (weak/strong modes; meshes keep "
+                        "the lane axis whole — untileable rungs are "
+                        "skipped)")
     a = p.parse_args(argv)
 
     jax = _setup_devices(a.virtual)
@@ -199,7 +225,11 @@ def main(argv=None) -> int:
 
     base = None
     rows = []
-    for mesh_shape in _mesh_ladder(n_devices, st.ndim):
+    ladder = _mesh_ladder(n_devices, st.ndim)
+    if a.fuse > 1 and st.ndim == 3:
+        # sharded-fused keeps the lane axis whole: decompose z/y only
+        ladder = [(*m2, 1) for m2 in _mesh_ladder(n_devices, 2)]
+    for mesh_shape in ladder:
         n_dev = math.prod(mesh_shape)
         if a.mode == "weak":
             block = parse_int_tuple(a.block)
@@ -208,9 +238,14 @@ def main(argv=None) -> int:
             global_shape = parse_int_tuple(a.grid)
             if any(g % m for g, m in zip(global_shape, mesh_shape)):
                 continue
-        mcells, per_step = bench_config(
+        got = bench_config(
             st, mesh_shape, global_shape, a.steps, a.reps,
-            overlap=a.overlap)
+            overlap=a.overlap, fuse=a.fuse)
+        if got is None:
+            print(f"[scaling] skip {mesh_shape}: untileable fused "
+                  f"k={a.fuse}", file=sys.stderr)
+            continue
+        mcells, per_step = got
         per_dev = mcells / n_dev
         if base is None:
             base = per_dev if a.mode == "weak" else mcells
@@ -219,7 +254,7 @@ def main(argv=None) -> int:
         rows.append((mesh_shape, global_shape, mcells, per_dev, eff))
         rec = {
             "mode": a.mode, "stencil": a.stencil,
-            "overlap": a.overlap,
+            "overlap": a.overlap, "fuse": a.fuse,
             "mesh": list(mesh_shape), "grid": list(global_shape),
             "mcells_per_s": round(mcells, 1),
             "mcells_per_s_per_device": round(per_dev, 1),

@@ -135,13 +135,16 @@ def config_from_args(argv=None) -> RunConfig:
 
 # Measured on the real v5e chip, round 3 (benchmarks/results_r03.json):
 # the whole-step raw Pallas kernels (ops/pallas/rawstep.py) beat XLA's
-# fusion for these stencils at every size — and for heat3d only in the
-# large-grid regime where XLA's pad+update fusion collapses (17.6 Gcells/s
-# at 512^3 vs 85 at 256^3; the raw kernel holds ~40).  The raw kernel is
-# ALSO the fallback for the fused families below when the run's cadences
-# or shape rule temporal blocking out.
-_RAW_WINS = {"heat3d27", "heat3d4th", "wave3d"}
-_CLIFF_CELLS = 100_000_000  # heat3d: jnp wins below, raw kernel above
+# fusion for these stencils at every size (heat3d27 raw 37.6 vs jnp 21.4;
+# wave3d raw 23.9 vs jnp 13.4; grayscott3d raw 22.7 vs jnp 14.4).  The
+# raw kernel is ALSO the fallback for the fused families below when the
+# run's cadences or shape rule temporal blocking out.
+_RAW_WINS = {"heat3d27", "wave3d", "grayscott3d"}
+# heat3d and heat3d4th: XLA's fusion WINS at 256^3-class sizes (86.3 /
+# 62.8 Gcells/s vs raw 41.1 / 37.9) and collapses on large grids (heat3d
+# 17.6 at 512^3) — jnp below the cliff, raw kernel above.
+_RAW_ABOVE_CLIFF = {"heat3d", "heat3d4th"}
+_CLIFF_CELLS = 100_000_000
 
 # Transparent temporal blocking (ops/pallas/fused.py), k steps per HBM
 # pass: the fastest measured path at every size for these families
@@ -212,7 +215,8 @@ def _raw_eligible(cfg: RunConfig, name: str) -> bool:
     if cfg.compute == "pallas":
         return True
     return name in _RAW_WINS or (
-        name == "heat3d" and math.prod(cfg.grid) >= _CLIFF_CELLS)
+        name in _RAW_ABOVE_CLIFF
+        and math.prod(cfg.grid) >= _CLIFF_CELLS)
 
 
 def resolve_raw_step(cfg: RunConfig, st):
