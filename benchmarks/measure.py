@@ -186,6 +186,12 @@ CONFIGS = [
     # physical peak; verify it isn't an N-vs-4N differencing artifact
     ("advect3d_256_f32_jnp_n150", "advect3d", (256, 256, 256), 150,
      "float32", "jnp"),
+    ("advect3d_512_f32_jnp", "advect3d", (512, 512, 512), 15, "float32",
+     "jnp"),
+    ("advect3d_256_f32_fused4", "advect3d", (256, 256, 256), 13, "float32",
+     "fused4"),
+    ("advect3d_512_f32_fused4", "advect3d", (512, 512, 512), 6, "float32",
+     "fused4"),
     ("advect3d_256_f32_raw", "advect3d", (256, 256, 256), 50, "float32",
      "raw"),
     ("grayscott3d_256_f32_jnp", "grayscott3d", (256, 256, 256), 30,

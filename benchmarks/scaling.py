@@ -195,6 +195,11 @@ def main(argv=None) -> int:
                         "the lane axis whole — untileable rungs are "
                         "skipped)")
     a = p.parse_args(argv)
+    if a.fuse > 1 and a.overlap:
+        # the fused step replaces the whole exchange+update; there is no
+        # interior/boundary split to select — reject rather than emit rows
+        # whose "overlap" label misattributes fused-path numbers
+        p.error("--fuse and --overlap are mutually exclusive")
 
     jax = _setup_devices(a.virtual)
     from mpi_cuda_process_tpu.config import parse_int_tuple

@@ -160,6 +160,25 @@ def _micro_wave3d(stencil, interpret):
     return micro
 
 
+def _micro_advect3d(stencil, interpret):
+    # First-order upwind, constant Courant numbers (ops/advection.py):
+    # each axis taps ONLY the upstream neighbor — one roll per nonzero
+    # component, direction chosen by the sign.
+    courant = tuple(float(c) for c in stencil.params["courant"])
+
+    def micro(fields, frame):
+        (cur,) = fields
+        acc = cur
+        for d, c in enumerate(courant):
+            if c == 0.0:
+                continue
+            up = _roll(cur, 1 if c > 0 else -1, d, interpret)
+            acc = acc - abs(c) * (cur - up)
+        return (jnp.where(frame, cur, acc),)
+
+    return micro
+
+
 def _micro_grayscott3d(stencil, interpret):
     # Two coupled diffusing fields, BOTH with footprints (unlike wave3d's
     # neighbor-free carry) — the jnp path pays 4 HBM arrays per step and
@@ -187,6 +206,7 @@ _MICRO = {
     "heat3d4th": (_micro_heat3d4th, 2, 1),
     "wave3d": (_micro_wave3d, 1, 2),
     "grayscott3d": (_micro_grayscott3d, 1, 2),
+    "advect3d": (_micro_advect3d, 1, 1),
 }
 
 

@@ -95,3 +95,23 @@ def test_scaling_halo_smoke(scaling, capsys):
     for rec in recs:
         assert rec["ms_per_step_full"] > 0
         assert 0.0 <= rec["halo_overhead_frac"] <= 1.0
+
+
+@pytest.mark.slow
+def test_scaling_fused_smoke(scaling, capsys):
+    """--fuse K: z/y-only mesh ladder, untileable rungs skipped, k-step
+    accounting (mcells uses steps*k real steps)."""
+    import jax
+
+    n = len(jax.devices())
+    rc = scaling.main([
+        "--mode", "weak", "--stencil", "heat3d", "--block", "16,16,128",
+        "--steps", "2", "--reps", "1", "--fuse", "4", "--virtual", str(n),
+    ])
+    assert rc == 0
+    recs = [json.loads(l) for l in capsys.readouterr().out.splitlines() if l]
+    assert recs, "fused weak mode emitted no records"
+    for rec in recs:
+        assert rec["fuse"] == 4
+        assert rec["mesh"][2] == 1  # lane axis never sharded
+        assert rec["mcells_per_s"] > 0

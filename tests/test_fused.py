@@ -46,6 +46,9 @@ def test_fused_matches_plain_steps(shape, k):
         ("heat3d4th", (16, 16, 128), 2, {}),   # halo 2: margin 4, 2m=8
         ("wave3d", (16, 16, 128), 4, {}),      # two-field leapfrog carry
         ("grayscott3d", (16, 16, 128), 4, {}),  # both fields halo'd
+        ("advect3d", (16, 16, 128), 4, {}),     # asymmetric upwind taps
+        ("advect3d", (16, 16, 128), 4,
+         {"cx": -0.3, "cy": 0.2, "cz": -0.1}),  # mixed-sign upwinding
     ],
 )
 def test_fused_families_match_plain_steps(name, shape, k, kw):
@@ -124,6 +127,11 @@ def test_unsupported_configs_return_none():
                      {"alpha": 0.1}, marks=pytest.mark.slow),
         pytest.param("wave3d", (32, 16, 128), (2, 2, 1), 4, {},
                      marks=pytest.mark.slow),
+        pytest.param("grayscott3d", (16, 16, 128), (2, 1, 1), 4, {},
+                     marks=pytest.mark.slow),   # both fields exchanged
+        pytest.param("advect3d", (16, 16, 128), (2, 1, 1), 4,
+                     {"cx": -0.3, "cy": 0.2, "cz": -0.1},
+                     marks=pytest.mark.slow),   # asymmetric across shards
     ],
 )
 def test_sharded_fused_matches_unsharded(name, grid, mesh_shape, k, kw):
