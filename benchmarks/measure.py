@@ -71,6 +71,15 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
         step = make_fused_step(st, grid, step_unit)
         if step is None:
             raise ValueError(f"untileable fused k={step_unit} for {grid}")
+    elif compute.startswith("full"):
+        # whole-grid VMEM temporal blocking (2D families)
+        from mpi_cuda_process_tpu.ops.pallas.fullgrid import (
+            make_fullgrid_step,
+        )
+        step_unit = int(compute[len("full"):])
+        step = make_fullgrid_step(st, grid, step_unit)
+        if step is None:
+            raise ValueError(f"untileable fullgrid k={step_unit} for {grid}")
     else:
         compute_fn = None
         if compute == "pallas":
@@ -226,6 +235,18 @@ CONFIGS = [
     ("wave3d_512_bf16", "wave3d", (512, 512, 512), 20, "bfloat16", "jnp"),
     # int32 GoL throughput (bit-exact family)
     ("life_2048_i32", "life", (2048, 2048), 200, None, "jnp"),
+    # whole-grid VMEM temporal blocking: 2D state fits VMEM entirely, so k
+    # steps cost ONE HBM round-trip (ops/pallas/fullgrid.py); k=16/32 are
+    # compute-bound probes of the VPU ceiling
+    ("life_2048_i32_full16", "life", (2048, 2048), 30, None, "full16"),
+    ("life_1024_i32_full32", "life", (1024, 1024), 30, None, "full32"),
+    ("heat2d_512_f32_full32", "heat2d", (512, 512), 40, "float32", "full32"),
+    ("heat2d_2048_f32_full16", "heat2d", (2048, 2048), 20, "float32",
+     "full16"),
+    ("wave2d_1024_f32_full16", "wave2d", (1024, 1024), 20, "float32",
+     "full16"),
+    ("grayscott2d_1024_f32_full16", "grayscott2d", (1024, 1024), 15,
+     "float32", "full16"),
     # compute_fn z-chunk kernel inside the pad step (M1 kernel, for the
     # record: measured below both jnp and raw — kept as the regression probe
     # for the pad-based pallas integration)

@@ -335,6 +335,16 @@ def build(cfg: RunConfig):
                     f"{st.name} on {cfg.grid}: needs a fused kernel, an "
                     f"unsharded x axis, per-shard z/y extents tileable in "
                     f"multiples of 2*k*halo (>= 8), and blocks >= k*halo")
+        elif st.ndim == 2:
+            # 2D grids fit VMEM whole: k steps per HBM residency, exact
+            # (no windows, no alignment constraint on k)
+            from .ops.pallas.fullgrid import make_fullgrid_step
+            fused = make_fullgrid_step(st, cfg.grid, cfg.fuse)
+            if fused is None:
+                raise ValueError(
+                    f"--fuse {cfg.fuse} unsupported for {st.name} on grid "
+                    f"{cfg.grid} (needs a 2D micro family, sublane/lane-"
+                    f"aligned extents, and a grid within the VMEM budget)")
         else:
             from .ops.pallas.fused import make_fused_step
             fused = make_fused_step(st, cfg.grid, cfg.fuse)

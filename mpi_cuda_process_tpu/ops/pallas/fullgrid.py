@@ -1,0 +1,146 @@
+"""Whole-grid temporal blocking for 2D stencils: k steps per HBM round-trip.
+
+2D state is tiny by TPU standards (512² f32 = 1 MiB, 2048² int32 = 16.8 MiB
+— v5e has 128 MiB VMEM), so unlike the 3D fused kernels (ops/pallas/fused.py,
+which tile overlapping windows and pay a temporal-validity margin), the 2D
+grid fits in VMEM *whole*: one program loads the state once, runs k
+micro-steps as a ``fori_loop`` (constant code size — no unroll blow-up, the
+suspected cause of the bf16 deep-unroll compile hang), re-pins the guard
+frame every micro-step from an iota mask, and stores once.
+
+No windows → no overlap redundancy, no alignment constraints on k, and the
+result is BIT-EXACT with k applications of the plain step for every k ≥ 1
+(the 3D kernels' few-ULP tap-order caveat does not apply here because the
+micro-steps reuse the same roll-based tap order every pass — asserted
+exactly in tests/test_fullgrid.py for int Life).
+
+Neighbor taps are rolls (shared ``_roll``): wrap-around values land only in
+the guard frame, which the per-micro-step mask re-pins — the same
+guard-cell isolation argument as rawstep.py/fused.py, here with zero
+approximation because the whole domain is present.
+
+Capability lineage: this is the reference's per-cell kernel pair
+(kernel.cu:70-113) taken to its TPU limit — where the reference re-uploaded
+the full grid every generation (kernel.cu:208, SURVEY.md §3.1), this kernel
+crosses HBM once per k generations.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..stencil import Fields, Stencil
+
+from .kernels import _VMEM_LIMIT_BYTES, _interpret_default, _roll
+
+# The heat/wave/advect/grayscott micro-steps read ndim from the stencil —
+# shared with the 3D windowed kernels (one definition, two kernel shapes).
+from .fused import _micro_advect, _micro_grayscott, _micro_heat, _micro_wave
+
+
+def _micro_life(stencil, interpret):
+    def micro(fields, frame):
+        (cur,) = fields
+        n = None
+        for dy in (-1, 0, 1):
+            for dx in (-1, 0, 1):
+                if dy == dx == 0:
+                    continue
+                t = _roll(_roll(cur, dy, 0, interpret), dx, 1, interpret)
+                n = t if n is None else n + t
+        new = ((n == 3) | ((n == 2) & (cur == 1))).astype(cur.dtype)
+        return (jnp.where(frame, cur, new),)
+
+    return micro
+
+
+# name -> (micro factory, halo, nfields)
+_MICRO2D = {
+    "life": (_micro_life, 1, 1),
+    "heat2d": (_micro_heat, 1, 1),
+    "mdf": (_micro_heat, 1, 1),
+    "wave2d": (_micro_wave, 1, 2),
+    "advect2d": (_micro_advect, 1, 1),
+    "grayscott2d": (_micro_grayscott, 1, 2),
+}
+
+# Estimated live VMEM copies of the grid inside the micro-loop (state +
+# roll temporaries + output staging), per field, measured against the full
+# raised scoped limit so the headline 2048^2 cases (16.8 MiB/grid) pass the
+# gate; a residual compile-time OOM on the real chip surfaces as a recorded
+# error (campaign) or the CLI auto-retry's jnp fallback.
+_LIVE_FACTOR = 5
+
+
+def _lane_round(n: int) -> int:
+    return -(-n // 128) * 128
+
+
+def fullgrid_supported(stencil: Stencil) -> bool:
+    return stencil.name in _MICRO2D
+
+
+def make_fullgrid_step(
+    stencil: Stencil,
+    global_shape: Sequence[int],
+    k: int,
+    interpret: Optional[bool] = None,
+):
+    """Build ``fields -> fields`` advancing k steps in one VMEM residency.
+
+    Returns None when unsupported (not a 2D micro family, k < 1, sublane/
+    lane-unaligned shape, or the grid does not fit the VMEM budget) —
+    callers fall back to the per-step path.
+    """
+    if not fullgrid_supported(stencil) or k < 1:
+        return None
+    if interpret is None:
+        interpret = _interpret_default()
+    H, W = (int(s) for s in global_shape)
+    itemsize = jnp.dtype(stencil.dtype).itemsize
+    sublane = 8 * max(1, 4 // itemsize)
+    if H % sublane or W % 128:
+        return None  # keep the jnp fallback for odd shapes
+    micro_factory, halo, nfields = _MICRO2D[stencil.name]
+    bytes_per_field = H * _lane_round(W) * itemsize
+    if _LIVE_FACTOR * nfields * bytes_per_field > _VMEM_LIMIT_BYTES:
+        return None
+    micro = micro_factory(stencil, interpret)
+
+    def kernel(*refs):
+        fields = tuple(r[...] for r in refs[:nfields])
+        like = fields[0]
+        yi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 0)
+        xi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 1)
+        frame = ((yi < halo) | (yi >= H - halo)
+                 | (xi < halo) | (xi >= W - halo))
+
+        def body(_, fs):
+            return micro(fs, frame)
+
+        fields = jax.lax.fori_loop(0, k, body, fields)
+        for o, f in zip(refs[nfields:], fields):
+            o[...] = f
+
+    spec = pl.BlockSpec((H, W), lambda: (0, 0))
+    call = pl.pallas_call(
+        kernel,
+        grid=(),
+        in_specs=[spec] * nfields,
+        out_specs=[spec] * nfields,
+        out_shape=[jax.ShapeDtypeStruct((H, W), stencil.dtype)
+                   for _ in range(nfields)],
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT_BYTES),
+    )
+
+    def step_k(fields: Fields) -> Fields:
+        return tuple(call(*fields))
+
+    return step_k

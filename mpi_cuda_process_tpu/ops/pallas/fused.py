@@ -83,21 +83,34 @@ _VMEM_LIMIT = int(_VMEM_LIMIT_BYTES * 0.8)
 # ---------------------------------------------------------------------------
 
 
-def _lap7(cur, interpret):
-    return (
-        _roll(cur, 1, 0, interpret) + _roll(cur, -1, 0, interpret)
-        + _roll(cur, 1, 1, interpret) + _roll(cur, -1, 1, interpret)
-        + _roll(cur, 1, 2, interpret) + _roll(cur, -1, 2, interpret)
-        - 6.0 * cur
-    )
+def _lap(cur, ndim, interpret):
+    """2*ndim+1-point Laplacian via rolls (5-point in 2D, 7-point in 3D).
+
+    Tap order matters: left-associated roll sum, center term LAST — the
+    same association as the jnp update path, preserving the fused==plain
+    bit-exactness the equivalence tests assert.
+    """
+    acc = None
+    for d in range(ndim):
+        for s in (1, -1):
+            r = _roll(cur, s, d, interpret)
+            acc = r if acc is None else acc + r
+    return acc - 2.0 * ndim * cur
 
 
-def _micro_heat3d(stencil, interpret):
+# The heat / wave / advect / grayscott micro-step factories read the
+# dimensionality from the stencil, so ONE definition serves both the 3D
+# windowed kernels here (_MICRO) and the 2D whole-grid kernels
+# (fullgrid._MICRO2D) — the 27-point/4th-order micros below stay 3D-only.
+
+
+def _micro_heat(stencil, interpret):
     alpha = float(stencil.params["alpha"])
+    ndim = stencil.ndim
 
     def micro(fields, frame):
         (cur,) = fields
-        new = cur + alpha * _lap7(cur, interpret)
+        new = cur + alpha * _lap(cur, ndim, interpret)
         return (jnp.where(frame, cur, new),)
 
     return micro
@@ -147,12 +160,13 @@ def _micro_heat3d4th(stencil, interpret):
     return micro
 
 
-def _micro_wave3d(stencil, interpret):
+def _micro_wave(stencil, interpret):
     c2dt2 = float(stencil.params["c2dt2"])
+    ndim = stencil.ndim
 
     def micro(fields, frame):
         u, uprev = fields
-        new = 2.0 * u - uprev + c2dt2 * _lap7(u, interpret)
+        new = 2.0 * u - uprev + c2dt2 * _lap(u, ndim, interpret)
         # leapfrog carry: new u_prev is the old u, verbatim (no pin needed
         # — its frame is correct by induction, exactly carry_map's rule)
         return (jnp.where(frame, u, new), u)
@@ -160,7 +174,7 @@ def _micro_wave3d(stencil, interpret):
     return micro
 
 
-def _micro_advect3d(stencil, interpret):
+def _micro_advect(stencil, interpret):
     # First-order upwind, constant Courant numbers (ops/advection.py):
     # each axis taps ONLY the upstream neighbor — one roll per nonzero
     # component, direction chosen by the sign.
@@ -179,8 +193,8 @@ def _micro_advect3d(stencil, interpret):
     return micro
 
 
-def _micro_grayscott3d(stencil, interpret):
-    # Two coupled diffusing fields, BOTH with footprints (unlike wave3d's
+def _micro_grayscott(stencil, interpret):
+    # Two coupled diffusing fields, BOTH with footprints (unlike wave's
     # neighbor-free carry) — the jnp path pays 4 HBM arrays per step and
     # measured 14.4 Gcells/s at 256^3 (results_r03.json); fusing k steps
     # amortizes all of it.
@@ -188,12 +202,13 @@ def _micro_grayscott3d(stencil, interpret):
     dv = float(stencil.params["dv"])
     f = float(stencil.params["f"])
     kappa = float(stencil.params["kappa"])
+    ndim = stencil.ndim
 
     def micro(fields, frame):
         u, v = fields
         uvv = u * v * v
-        new_u = u + du * _lap7(u, interpret) - uvv + f * (1.0 - u)
-        new_v = v + dv * _lap7(v, interpret) + uvv - (f + kappa) * v
+        new_u = u + du * _lap(u, ndim, interpret) - uvv + f * (1.0 - u)
+        new_v = v + dv * _lap(v, ndim, interpret) + uvv - (f + kappa) * v
         return (jnp.where(frame, u, new_u), jnp.where(frame, v, new_v))
 
     return micro
@@ -201,12 +216,12 @@ def _micro_grayscott3d(stencil, interpret):
 
 # name -> (micro factory, halo, carried fields)
 _MICRO = {
-    "heat3d": (_micro_heat3d, 1, 1),
+    "heat3d": (_micro_heat, 1, 1),
     "heat3d27": (_micro_heat3d27, 1, 1),
     "heat3d4th": (_micro_heat3d4th, 2, 1),
-    "wave3d": (_micro_wave3d, 1, 2),
-    "grayscott3d": (_micro_grayscott3d, 1, 2),
-    "advect3d": (_micro_advect3d, 1, 1),
+    "wave3d": (_micro_wave, 1, 2),
+    "grayscott3d": (_micro_grayscott, 1, 2),
+    "advect3d": (_micro_advect, 1, 1),
 }
 
 

@@ -1,0 +1,68 @@
+"""Whole-grid 2D temporal blocking == k applications of the plain step.
+
+Unlike the windowed 3D fused kernels (few-ULP tap-order tolerance), the
+whole-grid kernel must be BIT-EXACT for int Life and tight for floats: the
+entire domain is resident, so there is no temporal-validity margin and no
+tile-boundary reassociation.  Pallas interpret mode on CPU (SURVEY.md §4.4).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mpi_cuda_process_tpu import init_state, make_step, make_stencil
+from mpi_cuda_process_tpu.driver import make_runner
+from mpi_cuda_process_tpu.ops.pallas.fullgrid import make_fullgrid_step
+
+
+@pytest.mark.parametrize(
+    "name,shape,k,kw",
+    [
+        ("life", (16, 128), 4, {}),            # int32, bit-exact
+        ("life", (16, 128), 7, {}),            # no alignment constraint on k
+        ("heat2d", (16, 128), 4, {}),
+        ("mdf", (16, 128), 4, {}),             # reference-parameter alias
+        ("wave2d", (16, 128), 4, {}),          # two-field leapfrog carry
+        ("advect2d", (16, 128), 4, {"cx": -0.4, "cy": 0.2}),
+        ("grayscott2d", (16, 128), 4, {}),     # both fields coupled
+    ],
+)
+def test_fullgrid_matches_plain_steps(name, shape, k, kw):
+    st = make_stencil(name, **kw)
+    fields = init_state(st, shape, seed=7, kind="auto")
+    step = jax.jit(make_step(st, shape))
+    ref = fields
+    for _ in range(k):
+        ref = step(ref)
+    fused = make_fullgrid_step(st, shape, k, interpret=True)
+    assert fused is not None
+    out = jax.jit(fused)(fields)
+    assert len(out) == len(ref)
+    for o, r in zip(out, ref):
+        if jnp.issubdtype(o.dtype, jnp.integer):
+            assert jnp.array_equal(o, r)
+        else:
+            assert jnp.allclose(o, r, rtol=0, atol=1e-5), name
+
+
+def test_fullgrid_in_scan_runner():
+    st = make_stencil("life")
+    shape = (16, 128)
+    f0 = init_state(st, shape, seed=3, kind="random")
+    fused = make_fullgrid_step(st, shape, 4, interpret=True)
+    out = make_runner(fused, 3)(f0)
+    ref = make_runner(make_step(st, shape), 12)(
+        init_state(st, shape, seed=3, kind="random"))
+    assert jnp.array_equal(out[0], ref[0])
+
+
+def test_fullgrid_unsupported_returns_none():
+    st = make_stencil("heat2d")
+    # odd shapes keep the jnp fallback
+    assert make_fullgrid_step(st, (15, 128), 4, interpret=True) is None
+    assert make_fullgrid_step(st, (16, 100), 4, interpret=True) is None
+    # grids beyond the VMEM budget decline
+    assert make_fullgrid_step(st, (8192, 8192), 4, interpret=True) is None
+    # 3D models belong to ops/pallas/fused.py
+    assert make_fullgrid_step(
+        make_stencil("heat3d"), (16, 16, 128), 4, interpret=True) is None
