@@ -39,7 +39,9 @@ def _fence(fields) -> float:
 def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
             params=None):
     """compute: jnp | pallas (compute_fn inside the pad step) |
-    raw (whole-step raw kernel) | fusedK (temporal blocking, K steps/pass).
+    raw (whole-step raw kernel) | fusedK (3D windowed temporal blocking,
+    K steps/pass) | fullK (2D whole-grid-in-VMEM temporal blocking) |
+    copy (harness-calibration 1R+1W elementwise scan).
     """
     kw = dict(params or {})
     if dtype is not None:
@@ -247,6 +249,9 @@ CONFIGS = [
      "full16"),
     ("grayscott2d_1024_f32_full16", "grayscott2d", (1024, 1024), 15,
      "float32", "full16"),
+    ("sor2d_1024_f32_jnp", "sor2d", (1024, 1024), 100, "float32", "jnp"),
+    ("sor2d_1024_f32_full16", "sor2d", (1024, 1024), 15, "float32",
+     "full16"),
     # compute_fn z-chunk kernel inside the pad step (M1 kernel, for the
     # record: measured below both jnp and raw — kept as the regression probe
     # for the pad-based pallas integration)

@@ -40,7 +40,36 @@ from .kernels import _VMEM_LIMIT_BYTES, _interpret_default, _roll
 
 # The heat/wave/advect/grayscott micro-steps read ndim from the stencil —
 # shared with the 3D windowed kernels (one definition, two kernel shapes).
-from .fused import _micro_advect, _micro_grayscott, _micro_heat, _micro_wave
+from .fused import (
+    _lap,
+    _micro_advect,
+    _micro_grayscott,
+    _micro_heat,
+    _micro_wave,
+)
+
+
+def _micro_sor(stencil, interpret):
+    # Red-black SOR: one micro-step = red half-sweep then black half-sweep
+    # reading the fresh red values (ops/sor.py phases).  Multi-phase is
+    # trivial here — the whole domain is resident, so the black sweep's
+    # dependence on this step's red values needs no extra margin or
+    # exchange, unlike the windowed/sharded paths.  ``parity`` is supplied
+    # by the kernel prelude (computed ONCE per HBM pass, outside the
+    # fori_loop, via ops/sor._parity_mask — the single source of the
+    # color convention).
+    omega = float(stencil.params["omega"])
+    ndim = stencil.ndim
+
+    def micro(fields, frame, parity):
+        (cur,) = fields
+        for color in (0, 1):
+            relaxed = cur + (omega / (2 * ndim)) * _lap(cur, ndim, interpret)
+            new = jnp.where(parity == color, relaxed, cur)
+            cur = jnp.where(frame, fields[0], new)
+        return (cur,)
+
+    return micro
 
 
 def _micro_life(stencil, interpret):
@@ -67,6 +96,7 @@ _MICRO2D = {
     "wave2d": (_micro_wave, 1, 2),
     "advect2d": (_micro_advect, 1, 1),
     "grayscott2d": (_micro_grayscott, 1, 2),
+    "sor2d": (_micro_sor, 1, 1),
 }
 
 # Estimated live VMEM copies of the grid inside the micro-loop (state +
@@ -75,10 +105,6 @@ _MICRO2D = {
 # gate; a residual compile-time OOM on the real chip surfaces as a recorded
 # error (campaign) or the CLI auto-retry's jnp fallback.
 _LIVE_FACTOR = 5
-
-
-def _lane_round(n: int) -> int:
-    return -(-n // 128) * 128
 
 
 def fullgrid_supported(stencil: Stencil) -> bool:
@@ -107,7 +133,8 @@ def make_fullgrid_step(
     if H % sublane or W % 128:
         return None  # keep the jnp fallback for odd shapes
     micro_factory, halo, nfields = _MICRO2D[stencil.name]
-    bytes_per_field = H * _lane_round(W) * itemsize
+    # W % 128 == 0 was checked above, so W is its own lane-rounded size.
+    bytes_per_field = H * W * itemsize
     if _LIVE_FACTOR * nfields * bytes_per_field > _VMEM_LIMIT_BYTES:
         return None
     micro = micro_factory(stencil, interpret)
@@ -119,9 +146,17 @@ def make_fullgrid_step(
         xi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 1)
         frame = ((yi < halo) | (yi >= H - halo)
                  | (xi < halo) | (xi >= W - halo))
+        # Loop-invariant prelude: parity-sensitive models (red-black SOR)
+        # get their color mask computed once per HBM pass, not per
+        # micro-step (Mosaic does not reliably hoist out of fori_loop).
+        extra = ()
+        if stencil.parity_sensitive:
+            from ..sor import _parity_mask
+
+            extra = (_parity_mask(like.shape, 2),)
 
         def body(_, fs):
-            return micro(fs, frame)
+            return micro(fs, frame, *extra)
 
         fields = jax.lax.fori_loop(0, k, body, fields)
         for o, f in zip(refs[nfields:], fields):

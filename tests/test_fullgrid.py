@@ -25,6 +25,7 @@ from mpi_cuda_process_tpu.ops.pallas.fullgrid import make_fullgrid_step
         ("wave2d", (16, 128), 4, {}),          # two-field leapfrog carry
         ("advect2d", (16, 128), 4, {"cx": -0.4, "cy": 0.2}),
         ("grayscott2d", (16, 128), 4, {}),     # both fields coupled
+        ("sor2d", (16, 128), 4, {}),           # red-black multi-phase
     ],
 )
 def test_fullgrid_matches_plain_steps(name, shape, k, kw):
