@@ -459,20 +459,37 @@ def _run_once(cfg: RunConfig) -> Tuple:
     cells = math.prod(cfg.grid) * max(1, cfg.ensemble)
 
     if cfg.tol > 0:
-        if cfg.fuse or cfg.log_every or cfg.checkpoint_every or \
+        if cfg.log_every or cfg.checkpoint_every or \
                 cfg.dump_every or cfg.check_finite or cfg.debug_checks:
             raise ValueError(
-                "--tol runs inside one while_loop; it excludes --fuse, "
-                "--debug-checks, and periodic log/checkpoint/dump/"
+                "--tol runs inside one while_loop; it excludes "
+                "--debug-checks and periodic log/checkpoint/dump/"
                 "check-finite (a non-finite state never converges: the "
                 "residual stays NaN>tol and the loop exits at the "
                 "--iters cap)")
+        # --tol composes with --fuse: each while_loop body call advances
+        # `unit` real steps, so caps and cadences are converted to call
+        # units (the residual is then measured across unit*check_every
+        # real steps — the same chunked-residual semantics, coarser).
+        unit = max(1, cfg.fuse)
+        if unit > 1 and remaining % unit:
+            raise ValueError(
+                f"--tol with --fuse {unit} needs remaining iters "
+                f"({remaining}) to be a multiple of {unit}")
+        if unit > 1 and cfg.tol_check_every % unit:
+            # refuse rather than silently coarsen the residual chunk (the
+            # convergence criterion is defined over tol_check_every steps)
+            raise ValueError(
+                f"--tol with --fuse {unit} needs --tol-check-every "
+                f"({cfg.tol_check_every}) to be a multiple of {unit}")
         t0 = time.perf_counter()
         with _profiled(cfg):
-            fields, n_done, res = driver.run_until(
-                step_fn, fields, cfg.tol, remaining,
-                check_every=cfg.tol_check_every)
+            fields, n_calls, res = driver.run_until(
+                step_fn, fields, cfg.tol, remaining // unit,
+                check_every=cfg.tol_check_every // unit if unit > 1
+                else cfg.tol_check_every)
         dt = time.perf_counter() - t0
+        n_done = n_calls * unit
         mcells = cells * n_done / dt / 1e6 if n_done else 0.0
         log.info(
             "converged=%s after %d steps (residual %.3e, tol %.1e) in %.3fs"

@@ -309,3 +309,15 @@ def test_auto_fuse_policy_table(monkeypatch):
     # cadence misalignment blocks the upgrade
     assert cli.maybe_auto_fuse(
         RunConfig(stencil="heat3d", grid=(16, 16, 128), iters=6)).fuse == 0
+
+
+def test_tol_composes_with_fuse():
+    """--tol + --fuse: convergence inside the while_loop, k steps per call."""
+    base = dict(stencil="sor2d", grid=(16, 128), init="zero")
+    plain, _ = run(RunConfig(**base, iters=4000, tol=1e-3,
+                             tol_check_every=40))
+    fused, _ = run(RunConfig(**base, iters=4000, tol=1e-3,
+                             tol_check_every=40, fuse=8))
+    # Both must land on the same converged Laplace solution (hot walls).
+    np.testing.assert_allclose(
+        np.asarray(fused[0]), np.asarray(plain[0]), rtol=0, atol=5e-3)

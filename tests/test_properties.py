@@ -367,3 +367,39 @@ def test_fused_step_property(case, z, y, x, k, seed):
         np.testing.assert_allclose(
             np.asarray(g, np.float32), np.asarray(r, np.float32),
             rtol=0, atol=1e-3)
+
+
+@pytest.mark.slow
+@settings(max_examples=8, **_SETTINGS)
+@given(
+    case=hs.sampled_from([
+        ("life", {}), ("heat2d", {}), ("wave2d", {}),
+        ("advect2d", {"cx": -0.4, "cy": 0.2}), ("grayscott2d", {}),
+        ("sor2d", {}),
+    ]),
+    h=hs.sampled_from([8, 15, 16, 24, 100]),
+    w=hs.sampled_from([64, 100, 128, 256]),
+    k=hs.integers(1, 9),
+    seed=hs.integers(0, 2**16),
+)
+def test_fullgrid_step_property(case, h, w, k, seed):
+    """make_fullgrid_step either declines (odd shapes) or matches k steps."""
+    from mpi_cuda_process_tpu.ops.pallas.fullgrid import make_fullgrid_step
+
+    name, kw = case
+    st = make_stencil(name, **kw)
+    grid = (h, w)
+    full = make_fullgrid_step(st, grid, k, interpret=True)
+    if full is None:
+        assert h % 8 or w % 128  # aligned shapes this small never decline
+        return
+    fields = init_state(st, grid, seed=seed, density=0.3, kind="auto")
+    ref = fields
+    step = make_step(st, grid)
+    for _ in range(k):
+        ref = step(ref)
+    got = full(fields)
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(r, np.float32),
+            rtol=0, atol=1e-3)
