@@ -92,20 +92,28 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
         if fuse > 1:
             # temporal blocking UNDER decomposition: k micro-steps per
             # width-k exchange — the 4096^3-class execution strategy
+            # (3D windowed kernel / 2D whole-local-block kernel)
             from mpi_cuda_process_tpu.parallel.stepper import (
-                make_sharded_fused_step,
+                make_sharded_temporal_step,
             )
 
-            step = make_sharded_fused_step(st, mesh, global_shape, fuse)
+            step = make_sharded_temporal_step(st, mesh, global_shape, fuse)
             if step is None:
                 return None
             step_unit = fuse
         else:
             step = make_sharded_step(st, mesh, global_shape, overlap=overlap)
     elif fuse > 1:
-        from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
+        if st.ndim == 2:
+            from mpi_cuda_process_tpu.ops.pallas.fullgrid import (
+                make_fullgrid_step,
+            )
 
-        step = make_fused_step(st, global_shape, fuse)
+            step = make_fullgrid_step(st, global_shape, fuse)
+        else:
+            from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
+
+            step = make_fused_step(st, global_shape, fuse)
         if step is None:
             return None
         step_unit = fuse
@@ -234,11 +242,18 @@ def main(argv=None) -> int:
     if a.fuse > 1 and st.ndim == 3:
         # sharded-fused keeps the lane axis whole: decompose z/y only
         ladder = [(*m2, 1) for m2 in _mesh_ladder(n_devices, 2)]
+    elif a.fuse > 1 and st.ndim == 2:
+        # 2D whole-local-block kernel: row decomposition only
+        ladder = _mesh_ladder(n_devices, 1)
     for mesh_shape in ladder:
         n_dev = math.prod(mesh_shape)
         if a.mode == "weak":
-            block = parse_int_tuple(a.block)
-            global_shape = tuple(b * m for b, m in zip(block, mesh_shape))
+            block = parse_int_tuple(a.block)[:st.ndim]
+            if len(block) < st.ndim:
+                p.error(f"--block needs {st.ndim} extents for {a.stencil}")
+            # mesh tuples may be shorter than ndim (trailing axes unsharded)
+            counts = (tuple(mesh_shape) + (1,) * st.ndim)[:st.ndim]
+            global_shape = tuple(b * m for b, m in zip(block, counts))
         else:
             global_shape = parse_int_tuple(a.grid)
             if any(g % m for g, m in zip(global_shape, mesh_shape)):

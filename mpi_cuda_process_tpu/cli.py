@@ -326,15 +326,17 @@ def build(cfg: RunConfig):
                              "--compute pallas and --overlap")
         if use_mesh:
             # k fused steps per width-k*halo exchange (the 4096^3-class
-            # configuration: decomposition AND temporal blocking)
-            fused = stepper_lib.make_sharded_fused_step(
+            # configuration: decomposition AND temporal blocking); 2D
+            # grids use the whole-local-block VMEM kernel under a row
+            # decomposition (the reference's own 1-D split, k-amortized)
+            fused = stepper_lib.make_sharded_temporal_step(
                 st, m, cfg.grid, cfg.fuse)
             if fused is None:
                 raise ValueError(
                     f"--fuse {cfg.fuse} + --mesh {cfg.mesh} unsupported for "
                     f"{st.name} on {cfg.grid}: needs a fused kernel, an "
-                    f"unsharded x axis, per-shard z/y extents tileable in "
-                    f"multiples of 2*k*halo (>= 8), and blocks >= k*halo")
+                    f"unsharded lane axis, aligned per-shard extents, and "
+                    f"blocks >= the k-step margin")
         elif st.ndim == 2:
             # 2D grids fit VMEM whole: k steps per HBM residency, exact
             # (no windows, no alignment constraint on k)

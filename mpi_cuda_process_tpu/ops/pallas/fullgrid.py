@@ -111,6 +111,85 @@ def fullgrid_supported(stencil: Stencil) -> bool:
     return stencil.name in _MICRO2D
 
 
+def _build_call(stencil, block_shape, m, k, interpret, masked):
+    """Shared scaffolding for both whole-grid kernels (cf. fused.py's
+    single builder with a ``masked`` flag).
+
+    ``block_shape`` is the in-VMEM block: the whole grid (``masked=False``,
+    ``m == 0``, frame derived from iota) or the halo-padded local block
+    (``masked=True``, frame mask supplied as an extra input because the
+    shard's global origin is traced).  Output is the ``m``-inset core.
+    Returns ``(call, nfields)`` or None.
+    """
+    if not fullgrid_supported(stencil) or k < 1:
+        return None
+    if interpret is None:
+        interpret = _interpret_default()
+    Hp, W = (int(s) for s in block_shape)
+    Ly = Hp - 2 * m
+    itemsize = jnp.dtype(stencil.dtype).itemsize
+    sublane = 8 * max(1, 4 // itemsize)
+    # m-aligned output slice keeps the store sublane-aligned; Ly >= m keeps
+    # every halo slab single-neighbor (vacuous when m == 0).
+    if W % 128 or m % sublane or Ly < m or Ly % sublane:
+        return None
+    micro_factory, halo, nfields = _MICRO2D[stencil.name]
+    if masked:
+        # One micro-step advances information by halo cells PER PHASE: the
+        # red-black micro's black sweep reads this micro-step's fresh red
+        # values, so a full micro-step consumes 2*halo of validity margin.
+        halo_per_micro = halo * max(1, len(stencil.phases or ()))
+        if m != k * halo_per_micro:
+            return None
+    n_in = nfields + (1 if masked else 0)
+    if _LIVE_FACTOR * n_in * Hp * W * itemsize > _VMEM_LIMIT_BYTES:
+        return None
+    micro = micro_factory(stencil, interpret)
+
+    def kernel(*refs):
+        fields = tuple(r[...] for r in refs[:nfields])
+        like = fields[0]
+        if masked:
+            frame = refs[nfields][...] != 0
+        else:
+            yi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 0)
+            xi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 1)
+            frame = ((yi < halo) | (yi >= Hp - halo)
+                     | (xi < halo) | (xi >= W - halo))
+        # Loop-invariant prelude: parity-sensitive models (red-black SOR)
+        # get their color mask computed once per HBM pass, not per
+        # micro-step (Mosaic does not reliably hoist out of fori_loop).
+        # Block-local parity equals global parity because every offset in
+        # play (m, Ly, shard origin) is even by the alignment gates.
+        extra = ()
+        if stencil.parity_sensitive:
+            from ..sor import _parity_mask
+
+            extra = (_parity_mask(like.shape, 2),)
+
+        def body(_, fs):
+            return micro(fs, frame, *extra)
+
+        fields = jax.lax.fori_loop(0, k, body, fields)
+        for o, f in zip(refs[n_in:], fields):
+            o[...] = f[m:m + Ly, :] if m else f
+
+    in_spec = pl.BlockSpec((Hp, W), lambda: (0, 0))
+    out_spec = pl.BlockSpec((Ly, W), lambda: (0, 0))
+    call = pl.pallas_call(
+        kernel,
+        grid=(),
+        in_specs=[in_spec] * n_in,
+        out_specs=[out_spec] * nfields,
+        out_shape=[jax.ShapeDtypeStruct((Ly, W), stencil.dtype)
+                   for _ in range(nfields)],
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT_BYTES),
+    )
+    return call, nfields
+
+
 def make_fullgrid_step(
     stencil: Stencil,
     global_shape: Sequence[int],
@@ -123,59 +202,42 @@ def make_fullgrid_step(
     lane-unaligned shape, or the grid does not fit the VMEM budget) —
     callers fall back to the per-step path.
     """
-    if not fullgrid_supported(stencil) or k < 1:
+    built = _build_call(stencil, tuple(int(s) for s in global_shape),
+                        0, k, interpret, masked=False)
+    if built is None:
         return None
-    if interpret is None:
-        interpret = _interpret_default()
-    H, W = (int(s) for s in global_shape)
-    itemsize = jnp.dtype(stencil.dtype).itemsize
-    sublane = 8 * max(1, 4 // itemsize)
-    if H % sublane or W % 128:
-        return None  # keep the jnp fallback for odd shapes
-    micro_factory, halo, nfields = _MICRO2D[stencil.name]
-    # W % 128 == 0 was checked above, so W is its own lane-rounded size.
-    bytes_per_field = H * W * itemsize
-    if _LIVE_FACTOR * nfields * bytes_per_field > _VMEM_LIMIT_BYTES:
-        return None
-    micro = micro_factory(stencil, interpret)
-
-    def kernel(*refs):
-        fields = tuple(r[...] for r in refs[:nfields])
-        like = fields[0]
-        yi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 0)
-        xi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 1)
-        frame = ((yi < halo) | (yi >= H - halo)
-                 | (xi < halo) | (xi >= W - halo))
-        # Loop-invariant prelude: parity-sensitive models (red-black SOR)
-        # get their color mask computed once per HBM pass, not per
-        # micro-step (Mosaic does not reliably hoist out of fori_loop).
-        extra = ()
-        if stencil.parity_sensitive:
-            from ..sor import _parity_mask
-
-            extra = (_parity_mask(like.shape, 2),)
-
-        def body(_, fs):
-            return micro(fs, frame, *extra)
-
-        fields = jax.lax.fori_loop(0, k, body, fields)
-        for o, f in zip(refs[nfields:], fields):
-            o[...] = f
-
-    spec = pl.BlockSpec((H, W), lambda: (0, 0))
-    call = pl.pallas_call(
-        kernel,
-        grid=(),
-        in_specs=[spec] * nfields,
-        out_specs=[spec] * nfields,
-        out_shape=[jax.ShapeDtypeStruct((H, W), stencil.dtype)
-                   for _ in range(nfields)],
-        interpret=interpret,
-        compiler_params=None if interpret else pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT_BYTES),
-    )
+    call, _ = built
 
     def step_k(fields: Fields) -> Fields:
         return tuple(call(*fields))
 
     return step_k
+
+
+def build_fullgrid_masked_call(
+    stencil: Stencil,
+    padded_shape,
+    m: int,
+    k: int,
+    interpret: Optional[bool] = None,
+):
+    """Whole-LOCAL-block variant for the sharded 2D path (shard_map).
+
+    The caller (parallel.stepper.make_sharded_fullgrid_step) exchanges
+    width-``m`` y-halos (``m = k * halo * phases``), so the input blocks
+    are ``(local_y + 2m, X)`` and the frame mask (nonzero = pinned: global
+    guard frame + out-of-domain pad cells) arrives as an input array —
+    each shard's global origin is a traced axis_index, which the kernel
+    prelude cannot see.  Output is the core ``(local_y, X)``; rows within
+    ``m`` of the padded edge are temporal-validity casualties exactly as
+    in the windowed 3D kernels.  Parity-sensitive models derive color
+    from block-local coordinates, which matches global parity when the
+    caller enforces even local extents and even ``m`` (ops/sor.py's
+    documented sharding caveat).
+
+    Returns ``(call, nfields)`` or None (unsupported family, unaligned
+    shape, or VMEM budget exceeded).
+    """
+    if m < 1:
+        return None
+    return _build_call(stencil, padded_shape, m, k, interpret, masked=True)

@@ -47,6 +47,20 @@ def shard_fields(fields: Fields, mesh: Mesh, ndim: int) -> Fields:
     return tuple(jax.device_put(f, sharding) for f in fields)
 
 
+def _resolve_mesh_axes(ndim: int, mesh: Mesh):
+    """(axis_names, counts) for grid axes 0..ndim-1 over ``mesh``.
+
+    ``axis_names[d]`` is the mesh axis decomposing grid axis d (or None);
+    ``counts[d]`` its shard count.  Single source for every stepper.
+    """
+    from .mesh import spatial_axis_names
+
+    names_all = spatial_axis_names(ndim)
+    axis_names = tuple(n if n in mesh.shape else None for n in names_all)
+    counts = tuple(mesh.shape.get(n, 1) if n else 1 for n in axis_names)
+    return axis_names, counts
+
+
 def make_sharded_step(
     stencil: Stencil,
     mesh: Mesh,
@@ -75,13 +89,7 @@ def make_sharded_step(
     """
     ndim = stencil.ndim
     halo = stencil.halo
-    from .mesh import spatial_axis_names
-
-    names_all = spatial_axis_names(ndim)
-    axis_names: Tuple[Optional[str], ...] = tuple(
-        n if n in mesh.shape else None for n in names_all
-    )
-    counts = tuple(mesh.shape.get(n, 1) if n else 1 for n in axis_names)
+    axis_names, counts = _resolve_mesh_axes(ndim, mesh)
     for d, c in enumerate(counts):
         if global_shape[d] % c:
             raise ValueError(
@@ -254,11 +262,7 @@ def make_sharded_fused_step(
     ndim = stencil.ndim
     if ndim != 3 or not fused_supported(stencil) or stencil.phases:
         return None
-    from .mesh import spatial_axis_names
-
-    names_all = spatial_axis_names(ndim)
-    axis_names = tuple(n if n in mesh.shape else None for n in names_all)
-    counts = tuple(mesh.shape.get(n, 1) if n else 1 for n in axis_names)
+    axis_names, counts = _resolve_mesh_axes(ndim, mesh)
     if counts[2] > 1:
         return None  # lane axis must stay whole (in-kernel lane rolls)
     if any(g % c for g, c in zip(global_shape, counts)):
@@ -311,3 +315,98 @@ def make_sharded_fused_step(
         out_specs=spec,
         check_vma=False,
     )
+
+
+def make_sharded_fullgrid_step(
+    stencil: Stencil,
+    mesh: Mesh,
+    global_shape: Sequence[int],
+    k: int,
+    interpret: Optional[bool] = None,
+):
+    """2D temporal blocking under row decomposition: k steps per exchange.
+
+    The 2D analogue of ``make_sharded_fused_step`` — and the TPU
+    generalization of the reference's own decomposition (a 1-D row split,
+    kernel.cu:76/81): shard the y axis, exchange width ``k*halo`` row
+    slabs, then run the whole padded LOCAL block through the
+    whole-block-in-VMEM kernel (ops/pallas/fullgrid.py) for k micro-steps
+    — one exchange per k generations instead of one per generation.
+
+    Constraints (returns None when unmet): 2D fullgrid family; x (lane)
+    axis unsharded; the margin ``m = k * halo * max(1, phases)`` (a full
+    red-black micro-step consumes 2*halo of validity) a multiple of the
+    dtype's sublane tile (aligned core store); even local extents
+    (global==local parity for red-black models, ops/sor.py caveat);
+    local rows >= m (halo slabs stay single-neighbor); padded block
+    within the VMEM budget.
+    """
+    from ..ops.pallas.fullgrid import build_fullgrid_masked_call
+
+    ndim = stencil.ndim
+    if ndim != 2:
+        return None
+    axis_names, counts = _resolve_mesh_axes(ndim, mesh)
+    if counts[1] > 1:
+        return None  # lane axis must stay whole (in-kernel lane rolls)
+    if any(g % c for g, c in zip(global_shape, counts)):
+        return None
+    local_shape = tuple(g // c for g, c in zip(global_shape, counts))
+    # margin per micro-step = halo per PHASE (red-black consumes 2*halo)
+    m = k * stencil.halo * max(1, len(stencil.phases or ()))
+    built = build_fullgrid_masked_call(
+        stencil, (local_shape[0] + 2 * m, local_shape[1]), m, k,
+        interpret=interpret)
+    if built is None:
+        return None
+    call, nfields = built
+    assert nfields == stencil.num_fields
+    spec = grid_partition_spec(ndim, mesh)
+    H, W = (int(s) for s in global_shape)
+    h = stencil.halo
+
+    def local_step(fields: Fields) -> Fields:
+        from .halo import exchange_pad_axis
+
+        padded = [
+            exchange_pad_axis(f, 0, axis_names[0], counts[0], m, bc)
+            for f, bc in zip(fields, stencil.bc_value)
+        ]
+        y0 = lax.axis_index(axis_names[0]) * local_shape[0] \
+            if axis_names[0] else 0
+        pshape = padded[0].shape
+        gy = lax.broadcasted_iota(jnp.int32, pshape, 0) + y0 - m
+        gx = lax.broadcasted_iota(jnp.int32, pshape, 1)
+        mask = ((gy < h) | (gy >= H - h) | (gx < h) | (gx >= W - h))
+        return tuple(call(*padded, mask.astype(stencil.dtype)))
+
+    return shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=spec,
+        check_vma=False,
+    )
+
+
+def make_sharded_temporal_step(
+    stencil: Stencil,
+    mesh: Mesh,
+    global_shape: Sequence[int],
+    k: int,
+    interpret: Optional[bool] = None,
+):
+    """Temporal blocking under decomposition, any dimensionality.
+
+    Dispatches to the whole-local-block kernel for 2D stencils and the
+    windowed fused kernel for 3D — the single entry point for callers
+    (cli --fuse --mesh, benchmarks/scaling.py --fuse) that should not
+    care which kernel shape implements the k-steps-per-exchange strategy.
+    Returns None when the (stencil, mesh, shape, k) combination is
+    unsupported by the applicable builder.
+    """
+    if stencil.ndim == 2:
+        return make_sharded_fullgrid_step(
+            stencil, mesh, global_shape, k, interpret=interpret)
+    return make_sharded_fused_step(
+        stencil, mesh, global_shape, k, interpret=interpret)

@@ -67,3 +67,68 @@ def test_fullgrid_unsupported_returns_none():
     # 3D models belong to ops/pallas/fused.py
     assert make_fullgrid_step(
         make_stencil("heat3d"), (16, 16, 128), 4, interpret=True) is None
+
+
+# ---------------------------------------------------------------------------
+# sharded + whole-local-block composition: the reference's 1-D row split,
+# k generations per exchange
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "name,grid,mesh_n,k,kw",
+    [
+        ("life", (64, 128), 2, 8, {}),          # default tier: bit-exact int
+        pytest.param("sor2d", (64, 128), 2, 8, {},
+                     marks=pytest.mark.slow),    # 2-phase margin accounting
+        pytest.param("wave2d", (64, 128), 2, 8, {},
+                     marks=pytest.mark.slow),    # carry field
+        pytest.param("grayscott2d", (64, 128), 2, 8, {},
+                     marks=pytest.mark.slow),    # both fields exchanged
+        pytest.param("heat2d", (64, 128), 4, 8, {},
+                     marks=pytest.mark.slow),    # 4-way split
+    ],
+)
+def test_sharded_fullgrid_matches_unsharded(name, grid, mesh_n, k, kw):
+    from mpi_cuda_process_tpu import make_mesh, shard_fields
+    from mpi_cuda_process_tpu.parallel.stepper import (
+        make_sharded_fullgrid_step,
+    )
+
+    st = make_stencil(name, **kw)
+    fields = init_state(st, grid, seed=5, density=0.3, kind="auto")
+    ref = fields
+    step = jax.jit(make_step(st, grid))
+    for _ in range(k):
+        ref = step(ref)
+    mesh = make_mesh((mesh_n,))
+    fused = make_sharded_fullgrid_step(st, mesh, grid, k, interpret=True)
+    assert fused is not None
+    got = jax.jit(fused)(shard_fields(fields, mesh, 2))
+    for g, r in zip(got, ref):
+        if jnp.issubdtype(g.dtype, jnp.integer):
+            assert jnp.array_equal(g, r)
+        else:
+            assert jnp.allclose(g, r, rtol=0, atol=1e-4), name
+
+
+def test_sharded_fullgrid_unsupported_configs():
+    from mpi_cuda_process_tpu import make_mesh
+    from mpi_cuda_process_tpu.parallel.stepper import (
+        make_sharded_fullgrid_step,
+    )
+
+    st = make_stencil("heat2d")
+    # sharded lane axis -> None
+    mesh_x = make_mesh((1, 2))
+    assert make_sharded_fullgrid_step(
+        st, mesh_x, (64, 256), 8, interpret=True) is None
+    # local rows smaller than the k-step margin (and sublane-unaligned)
+    # -> None.  (Ly == m is legal: the slab is the whole neighbor block —
+    # verified bit-exact for heat2d 64x128 on an (8,) mesh.)
+    mesh_y = make_mesh((4,))
+    assert make_sharded_fullgrid_step(
+        st, mesh_y, (16, 128), 8, interpret=True) is None
+    # 3D stencils belong to make_sharded_fused_step
+    assert make_sharded_fullgrid_step(
+        make_stencil("heat3d"), make_mesh((2, 1, 1)), (16, 16, 128), 4,
+        interpret=True) is None
