@@ -318,9 +318,6 @@ def build(cfg: RunConfig):
     if cfg.fuse:
         if cfg.ensemble:
             raise ValueError("--fuse currently excludes --ensemble")
-        if cfg.periodic:
-            raise ValueError("--fuse currently requires guard-frame BCs "
-                             "(no --periodic)")
         if cfg.compute == "pallas" or cfg.overlap:
             raise ValueError("--fuse replaces the whole step; it excludes "
                              "--compute pallas and --overlap")
@@ -330,7 +327,7 @@ def build(cfg: RunConfig):
             # grids use the whole-local-block VMEM kernel under a row
             # decomposition (the reference's own 1-D split, k-amortized)
             fused = stepper_lib.make_sharded_temporal_step(
-                st, m, cfg.grid, cfg.fuse)
+                st, m, cfg.grid, cfg.fuse, periodic=cfg.periodic)
             if fused is None:
                 raise ValueError(
                     f"--fuse {cfg.fuse} + --mesh {cfg.mesh} unsupported for "
@@ -341,7 +338,8 @@ def build(cfg: RunConfig):
             # 2D grids fit VMEM whole: k steps per HBM residency, exact
             # (no windows, no alignment constraint on k)
             from .ops.pallas.fullgrid import make_fullgrid_step
-            fused = make_fullgrid_step(st, cfg.grid, cfg.fuse)
+            fused = make_fullgrid_step(st, cfg.grid, cfg.fuse,
+                                       periodic=cfg.periodic)
             if fused is None:
                 raise ValueError(
                     f"--fuse {cfg.fuse} unsupported for {st.name} on grid "
@@ -349,7 +347,8 @@ def build(cfg: RunConfig):
                     f"aligned extents, and a grid within the VMEM budget)")
         else:
             from .ops.pallas.fused import make_fused_step
-            fused = make_fused_step(st, cfg.grid, cfg.fuse)
+            fused = make_fused_step(st, cfg.grid, cfg.fuse,
+                                    periodic=cfg.periodic)
             if fused is None:
                 raise ValueError(
                     f"--fuse {cfg.fuse} unsupported for {st.name} on grid "

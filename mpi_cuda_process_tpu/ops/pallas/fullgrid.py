@@ -111,7 +111,8 @@ def fullgrid_supported(stencil: Stencil) -> bool:
     return stencil.name in _MICRO2D
 
 
-def _build_call(stencil, block_shape, m, k, interpret, masked):
+def _build_call(stencil, block_shape, m, k, interpret, masked,
+                periodic=False):
     """Shared scaffolding for both whole-grid kernels (cf. fused.py's
     single builder with a ``masked`` flag).
 
@@ -119,7 +120,10 @@ def _build_call(stencil, block_shape, m, k, interpret, masked):
     ``m == 0``, frame derived from iota) or the halo-padded local block
     (``masked=True``, frame mask supplied as an extra input because the
     shard's global origin is traced).  Output is the ``m``-inset core.
-    Returns ``(call, nfields)`` or None.
+    ``periodic`` (unmasked only): no guard frame exists — the neighbor
+    rolls' wrap-around IS the periodic boundary, exactly (rolls wrap at
+    the domain extents because the whole grid is the block), so the frame
+    mask is identically False.  Returns ``(call, nfields)`` or None.
     """
     if not fullgrid_supported(stencil) or k < 1:
         return None
@@ -134,7 +138,9 @@ def _build_call(stencil, block_shape, m, k, interpret, masked):
     if W % 128 or m % sublane or Ly < m or Ly % sublane:
         return None
     micro_factory, halo, nfields = _MICRO2D[stencil.name]
-    if masked:
+    if m and not masked and not periodic:
+        return None  # an inset store without a mask needs periodic wrap
+    if m:
         # One micro-step advances information by halo cells PER PHASE: the
         # red-black micro's black sweep reads this micro-step's fresh red
         # values, so a full micro-step consumes 2*halo of validity margin.
@@ -151,6 +157,8 @@ def _build_call(stencil, block_shape, m, k, interpret, masked):
         like = fields[0]
         if masked:
             frame = refs[nfields][...] != 0
+        elif periodic:
+            frame = jnp.zeros(like.shape, jnp.bool_)
         else:
             yi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 0)
             xi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 1)
@@ -195,15 +203,22 @@ def make_fullgrid_step(
     global_shape: Sequence[int],
     k: int,
     interpret: Optional[bool] = None,
+    periodic: bool = False,
 ):
     """Build ``fields -> fields`` advancing k steps in one VMEM residency.
 
-    Returns None when unsupported (not a 2D micro family, k < 1, sublane/
-    lane-unaligned shape, or the grid does not fit the VMEM budget) —
-    callers fall back to the per-step path.
+    ``periodic=True`` drops the guard frame entirely: the in-VMEM rolls
+    wrap at the domain extents, which IS the periodic boundary (for
+    parity-sensitive models this additionally requires even extents,
+    matching make_sharded_step's gate).  Returns None when unsupported
+    (not a 2D micro family, k < 1, sublane/lane-unaligned shape, or the
+    grid does not fit the VMEM budget) — callers fall back to the
+    per-step path.
     """
+    # (No parity/odd-extent gate needed for periodic red-black models:
+    # the alignment gates in _build_call already force even extents.)
     built = _build_call(stencil, tuple(int(s) for s in global_shape),
-                        0, k, interpret, masked=False)
+                        0, k, interpret, masked=False, periodic=periodic)
     if built is None:
         return None
     call, _ = built
@@ -220,6 +235,7 @@ def build_fullgrid_masked_call(
     m: int,
     k: int,
     interpret: Optional[bool] = None,
+    periodic: bool = False,
 ):
     """Whole-LOCAL-block variant for the sharded 2D path (shard_map).
 
@@ -240,4 +256,9 @@ def build_fullgrid_masked_call(
     """
     if m < 1:
         return None
-    return _build_call(stencil, padded_shape, m, k, interpret, masked=True)
+    # Periodic drops the mask input entirely (frame is identically False
+    # and the exchanged slabs are real wrapped data) — no constant-zero
+    # array streamed through VMEM, and the budget gate counts one fewer
+    # input.
+    return _build_call(stencil, padded_shape, m, k, interpret,
+                       masked=not periodic, periodic=periodic)

@@ -231,7 +231,8 @@ def _assemble_window(a, b, c, d):
     return jnp.concatenate([top, bot], axis=0)
 
 
-def _fused_kernel(micro, nfields, k, margin, bz, by, shape, interpret, *refs):
+def _fused_kernel(micro, nfields, k, margin, bz, by, shape, periodic,
+                  interpret, *refs):
     """k micro-steps on constant-shape VMEM windows; multi-field generic.
 
     ``refs`` is 4 window blocks per field (core, y-tail, z-tail, corner —
@@ -245,6 +246,10 @@ def _fused_kernel(micro, nfields, k, margin, bz, by, shape, interpret, *refs):
     passes ``shape=None`` and supplies the mask as a windowed input instead
     (each shard's global origin is a traced axis_index, which a BlockSpec
     index_map cannot see).
+
+    ``periodic`` (with ``shape``): no guard frame — the caller wrap-pads
+    z/y, and the in-window lane rolls wrap at X = the full domain width
+    (x is never sharded or padded), which IS the periodic x boundary.
     """
     fields = tuple(
         _assemble_window(*refs[4 * f:4 * f + 4]) for f in range(nfields))
@@ -253,22 +258,26 @@ def _fused_kernel(micro, nfields, k, margin, bz, by, shape, interpret, *refs):
         outs = refs[4 * nfields + 4:]
     else:
         outs = refs[4 * nfields:]
-        iz = pl.program_id(0)
-        iy = pl.program_id(1)
-        # Window origin in global coords (input pre-padded by margin in z/y).
-        z0 = iz * bz - margin
-        y0 = iy * by - margin
-        Z, Y, X = shape
-        halo = margin // k
-        like = fields[0]
-        zidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 0) + z0
-        yidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 1) + y0
-        xidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 2)
-        frame = (
-            (zidx < halo) | (zidx >= Z - halo)
-            | (yidx < halo) | (yidx >= Y - halo)
-            | (xidx < halo) | (xidx >= X - halo)
-        )
+        if periodic:
+            frame = jnp.zeros(fields[0].shape, jnp.bool_)
+        else:
+            iz = pl.program_id(0)
+            iy = pl.program_id(1)
+            # Window origin in global coords (input pre-padded by margin
+            # in z/y).
+            z0 = iz * bz - margin
+            y0 = iy * by - margin
+            Z, Y, X = shape
+            halo = margin // k
+            like = fields[0]
+            zidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 0) + z0
+            yidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 1) + y0
+            xidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 2)
+            frame = (
+                (zidx < halo) | (zidx >= Z - halo)
+                | (yidx < halo) | (yidx >= Y - halo)
+                | (xidx < halo) | (xidx >= X - halo)
+            )
     for _ in range(k):
         fields = micro(fields, frame)
     for o, f in zip(outs, fields):
@@ -329,6 +338,7 @@ def build_fused_call(
     tiles: Optional[Tuple[int, int]] = None,
     interpret: Optional[bool] = None,
     masked: bool = False,
+    periodic: bool = False,
 ):
     """Construct the fused pallas_call over a (core) block of ``core_shape``.
 
@@ -377,7 +387,7 @@ def build_fused_call(
     call = pl.pallas_call(
         functools.partial(
             _fused_kernel, micro, nfields, k, m, bz, by,
-            None if masked else (Z, Y, X), interpret),
+            None if masked else (Z, Y, X), periodic, interpret),
         grid=grid,
         in_specs=per_field_specs * n_in_sets,
         out_specs=[out_spec] * nfields,
@@ -397,25 +407,31 @@ def make_fused_step(
     k: int,
     tiles: Optional[Tuple[int, int]] = None,
     interpret: Optional[bool] = None,
+    periodic: bool = False,
 ):
     """Build ``fields -> fields`` advancing ``k`` steps in one kernel pass.
 
     Semantically identical to ``k`` applications of ``driver.make_step`` for
     the same stencil/shape (guard-frame semantics included) — asserted by
-    tests/test_fused.py.  Returns None when the shape/k cannot be tiled
-    (callers fall back to the per-step path).  ``2 * k * halo`` must be a
-    multiple of the dtype's sublane tile (8 for f32, 16 for bf16 — see
-    ``_sublane``), i.e. f32 halo-1 needs k in {4, 8, ...}, bf16 halo-1
-    needs k in {8, 16, ...}.
+    tests/test_fused.py.  ``periodic=True`` wrap-pads z/y instead of
+    zero-padding and drops the frame pin (the lane rolls wrap at the full
+    domain width, which IS the periodic x boundary).  Returns None when
+    the shape/k cannot be tiled (callers fall back to the per-step path).
+    ``2 * k * halo`` must be a multiple of the dtype's sublane tile (8 for
+    f32, 16 for bf16 — see ``_sublane``), i.e. f32 halo-1 needs k in
+    {4, 8, ...}, bf16 halo-1 needs k in {8, 16, ...}.
     """
     built = build_fused_call(
-        stencil, tuple(int(s) for s in global_shape), k, tiles, interpret)
+        stencil, tuple(int(s) for s in global_shape), k, tiles, interpret,
+        periodic=periodic)
     if built is None:
         return None
     call, m, _ = built
+    pad_mode = "wrap" if periodic else "constant"
 
     def step_k(fields: Fields) -> Fields:
-        padded = [jnp.pad(f, ((m, m), (m, m), (0, 0))) for f in fields]
+        padded = [jnp.pad(f, ((m, m), (m, m), (0, 0)), mode=pad_mode)
+                  for f in fields]
         args = [p for p in padded for _ in range(4)]
         return tuple(call(*args))
 

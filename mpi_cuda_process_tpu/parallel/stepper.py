@@ -224,6 +224,7 @@ def make_sharded_fused_step(
     global_shape: Sequence[int],
     k: int,
     interpret: Optional[bool] = None,
+    periodic: bool = False,
 ):
     """Temporal blocking under domain decomposition: k steps per exchange.
 
@@ -268,8 +269,12 @@ def make_sharded_fused_step(
     if any(g % c for g, c in zip(global_shape, counts)):
         return None
     local_shape = tuple(g // c for g, c in zip(global_shape, counts))
+    # Periodic uses the UNMASKED kernel (frame identically False): no
+    # constant-zero mask array is streamed, and _pick_tiles budgets one
+    # fewer input.  Only the guard-frame case needs the mask input (the
+    # shard's global origin is traced).
     built = build_fused_call(stencil, local_shape, k, interpret=interpret,
-                             masked=True)
+                             masked=not periodic, periodic=periodic)
     if built is None:
         return None
     call, m, nfields = built
@@ -285,7 +290,8 @@ def make_sharded_fused_step(
         for f, bc in zip(fields, stencil.bc_value):
             for d in (0, 1):
                 f = exchange_pad_axis(
-                    f, d, axis_names[d], counts[d], m, bc)
+                    f, d, axis_names[d], counts[d], m, bc,
+                    periodic=periodic)
             padded.append(f)
         # frame mask over the padded block, from global coordinates
         # (nonzero = pinned: the guard frame AND out-of-domain pad cells)
@@ -293,19 +299,19 @@ def make_sharded_fused_step(
             lax.axis_index(n) * ls if n else 0
             for n, ls in zip(axis_names, local_shape)
         )
-        h = stencil.halo
-        pshape = padded[0].shape
-        mask = None
-        for d in range(3):
-            pad_d = m if d < 2 else 0
-            coord = (lax.broadcasted_iota(jnp.int32, pshape, d)
-                     + offs[d] - pad_d)
-            g = global_shape[d]
-            md = (coord < h) | (coord >= g - h)
-            mask = md if mask is None else mask | md
-        maskf = mask.astype(stencil.dtype)
         args = [p for p in padded for _ in range(4)]
-        args += [maskf] * 4
+        if not periodic:
+            h = stencil.halo
+            pshape = padded[0].shape
+            mask = None
+            for d in range(3):
+                pad_d = m if d < 2 else 0
+                coord = (lax.broadcasted_iota(jnp.int32, pshape, d)
+                         + offs[d] - pad_d)
+                g = global_shape[d]
+                md = (coord < h) | (coord >= g - h)
+                mask = md if mask is None else mask | md
+            args += [mask.astype(stencil.dtype)] * 4
         return tuple(call(*args))
 
     return shard_map(
@@ -323,6 +329,7 @@ def make_sharded_fullgrid_step(
     global_shape: Sequence[int],
     k: int,
     interpret: Optional[bool] = None,
+    periodic: bool = False,
 ):
     """2D temporal blocking under row decomposition: k steps per exchange.
 
@@ -351,12 +358,14 @@ def make_sharded_fullgrid_step(
         return None  # lane axis must stay whole (in-kernel lane rolls)
     if any(g % c for g, c in zip(global_shape, counts)):
         return None
+    # (No parity/odd-extent gate needed for periodic red-black models:
+    # the alignment gates in the builder already force even extents.)
     local_shape = tuple(g // c for g, c in zip(global_shape, counts))
     # margin per micro-step = halo per PHASE (red-black consumes 2*halo)
     m = k * stencil.halo * max(1, len(stencil.phases or ()))
     built = build_fullgrid_masked_call(
         stencil, (local_shape[0] + 2 * m, local_shape[1]), m, k,
-        interpret=interpret)
+        interpret=interpret, periodic=periodic)
     if built is None:
         return None
     call, nfields = built
@@ -369,11 +378,16 @@ def make_sharded_fullgrid_step(
         from .halo import exchange_pad_axis
 
         padded = [
-            exchange_pad_axis(f, 0, axis_names[0], counts[0], m, bc)
+            exchange_pad_axis(f, 0, axis_names[0], counts[0], m, bc,
+                              periodic=periodic)
             for f, bc in zip(fields, stencil.bc_value)
         ]
         y0 = lax.axis_index(axis_names[0]) * local_shape[0] \
             if axis_names[0] else 0
+        if periodic:
+            # wrapped slabs are real data; the x rolls wrap at the full
+            # domain width (x unsharded) — nothing is pinned, no mask input
+            return tuple(call(*padded))
         pshape = padded[0].shape
         gy = lax.broadcasted_iota(jnp.int32, pshape, 0) + y0 - m
         gx = lax.broadcasted_iota(jnp.int32, pshape, 1)
@@ -395,6 +409,7 @@ def make_sharded_temporal_step(
     global_shape: Sequence[int],
     k: int,
     interpret: Optional[bool] = None,
+    periodic: bool = False,
 ):
     """Temporal blocking under decomposition, any dimensionality.
 
@@ -407,6 +422,8 @@ def make_sharded_temporal_step(
     """
     if stencil.ndim == 2:
         return make_sharded_fullgrid_step(
-            stencil, mesh, global_shape, k, interpret=interpret)
+            stencil, mesh, global_shape, k, interpret=interpret,
+            periodic=periodic)
     return make_sharded_fused_step(
-        stencil, mesh, global_shape, k, interpret=interpret)
+        stencil, mesh, global_shape, k, interpret=interpret,
+        periodic=periodic)

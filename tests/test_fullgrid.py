@@ -132,3 +132,49 @@ def test_sharded_fullgrid_unsupported_configs():
     assert make_sharded_fullgrid_step(
         make_stencil("heat3d"), make_mesh((2, 1, 1)), (16, 16, 128), 4,
         interpret=True) is None
+
+
+@pytest.mark.parametrize("name,kw", [
+    ("life", {}),                              # wrap is bit-exact
+    ("sor2d", {}),                             # parity under wrap
+])
+def test_fullgrid_periodic_matches_plain(name, kw):
+    st = make_stencil(name, **kw)
+    grid = (16, 128)
+    f0 = init_state(st, grid, seed=11, density=0.35, kind="random",
+                    periodic=True)
+    step = jax.jit(make_step(st, grid, periodic=True))
+    ref = f0
+    for _ in range(8):
+        ref = step(ref)
+    full = make_fullgrid_step(st, grid, 8, interpret=True, periodic=True)
+    assert full is not None
+    got = jax.jit(full)(f0)
+    for g, r in zip(got, ref):
+        if jnp.issubdtype(g.dtype, jnp.integer):
+            assert jnp.array_equal(g, r)
+        else:
+            assert jnp.allclose(g, r, rtol=0, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_sharded_fullgrid_periodic_matches_plain():
+    from mpi_cuda_process_tpu import make_mesh, shard_fields
+    from mpi_cuda_process_tpu.parallel.stepper import (
+        make_sharded_temporal_step,
+    )
+
+    st = make_stencil("life")
+    grid = (64, 128)
+    f0 = init_state(st, grid, seed=6, density=0.35, kind="random",
+                    periodic=True)
+    step = jax.jit(make_step(st, grid, periodic=True))
+    ref = f0
+    for _ in range(8):
+        ref = step(ref)
+    mesh = make_mesh((2,))
+    fused = make_sharded_temporal_step(st, mesh, grid, 8, interpret=True,
+                                       periodic=True)
+    assert fused is not None
+    got = jax.jit(fused)(shard_fields(f0, mesh, 2))
+    assert jnp.array_equal(got[0], ref[0])
