@@ -38,38 +38,17 @@ from ..stencil import Fields, Stencil
 
 from .kernels import _VMEM_LIMIT_BYTES, _interpret_default, _roll
 
-# The heat/wave/advect/grayscott micro-steps read ndim from the stencil —
-# shared with the 3D windowed kernels (one definition, two kernel shapes).
+# The heat/wave/advect/grayscott/sor micro-steps read ndim from the
+# stencil — shared with the 3D windowed kernels (one definition, two
+# kernel shapes).  ``_micro_sor``'s parity arg is supplied here by the
+# kernel prelude (ops/sor._parity_mask, computed once per HBM pass).
 from .fused import (
-    _lap,
     _micro_advect,
     _micro_grayscott,
     _micro_heat,
+    _micro_sor,
     _micro_wave,
 )
-
-
-def _micro_sor(stencil, interpret):
-    # Red-black SOR: one micro-step = red half-sweep then black half-sweep
-    # reading the fresh red values (ops/sor.py phases).  Multi-phase is
-    # trivial here — the whole domain is resident, so the black sweep's
-    # dependence on this step's red values needs no extra margin or
-    # exchange, unlike the windowed/sharded paths.  ``parity`` is supplied
-    # by the kernel prelude (computed ONCE per HBM pass, outside the
-    # fori_loop, via ops/sor._parity_mask — the single source of the
-    # color convention).
-    omega = float(stencil.params["omega"])
-    ndim = stencil.ndim
-
-    def micro(fields, frame, parity):
-        (cur,) = fields
-        for color in (0, 1):
-            relaxed = cur + (omega / (2 * ndim)) * _lap(cur, ndim, interpret)
-            new = jnp.where(parity == color, relaxed, cur)
-            cur = jnp.where(frame, fields[0], new)
-        return (cur,)
-
-    return micro
 
 
 def _micro_life(stencil, interpret):
@@ -111,6 +90,14 @@ def fullgrid_supported(stencil: Stencil) -> bool:
     return stencil.name in _MICRO2D
 
 
+def _halo_per_micro_2d(stencil: Stencil) -> int:
+    """Validity margin per micro-step: halo cells PER PHASE (the 2D
+    registry's counterpart of fused._halo_per_micro — same rule, keyed on
+    _MICRO2D)."""
+    micro_halo = _MICRO2D[stencil.name][1]
+    return micro_halo * max(1, len(stencil.phases or ()))
+
+
 def _build_call(stencil, block_shape, m, k, interpret, masked,
                 periodic=False):
     """Shared scaffolding for both whole-grid kernels (cf. fused.py's
@@ -141,11 +128,10 @@ def _build_call(stencil, block_shape, m, k, interpret, masked,
     if m and not masked and not periodic:
         return None  # an inset store without a mask needs periodic wrap
     if m:
-        # One micro-step advances information by halo cells PER PHASE: the
-        # red-black micro's black sweep reads this micro-step's fresh red
-        # values, so a full micro-step consumes 2*halo of validity margin.
-        halo_per_micro = halo * max(1, len(stencil.phases or ()))
-        if m != k * halo_per_micro:
+        # One micro-step advances information by halo cells PER PHASE (the
+        # red-black black sweep reads this micro-step's fresh red values):
+        # shared accounting with the 3D windowed kernels.
+        if m != k * _halo_per_micro_2d(stencil):
             return None
     n_in = nfields + (1 if masked else 0)
     if _LIVE_FACTOR * n_in * Hp * W * itemsize > _VMEM_LIMIT_BYTES:

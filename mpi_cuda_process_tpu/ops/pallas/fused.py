@@ -214,6 +214,27 @@ def _micro_grayscott(stencil, interpret):
     return micro
 
 
+def _micro_sor(stencil, interpret):
+    # Red-black SOR: one micro-step = red half-sweep then black half-sweep
+    # reading the fresh red values (ops/sor.py phases).  ``parity`` is the
+    # kernel-supplied color mask (global coordinate parity — derived from
+    # program ids here, from the prelude iotas in fullgrid.py); the black
+    # sweep's dependence on fresh red values is why a full micro-step
+    # consumes 2*halo of validity margin (see ``_halo_per_micro``).
+    omega = float(stencil.params["omega"])
+    ndim = stencil.ndim
+
+    def micro(fields, frame, parity):
+        (cur,) = fields
+        for color in (0, 1):
+            relaxed = cur + (omega / (2 * ndim)) * _lap(cur, ndim, interpret)
+            new = jnp.where(parity == color, relaxed, cur)
+            cur = jnp.where(frame, fields[0], new)
+        return (cur,)
+
+    return micro
+
+
 # name -> (micro factory, halo, carried fields)
 _MICRO = {
     "heat3d": (_micro_heat, 1, 1),
@@ -222,7 +243,14 @@ _MICRO = {
     "wave3d": (_micro_wave, 1, 2),
     "grayscott3d": (_micro_grayscott, 1, 2),
     "advect3d": (_micro_advect, 1, 1),
+    "sor3d": (_micro_sor, 1, 1),
 }
+
+
+def _halo_per_micro(stencil: Stencil) -> int:
+    """Validity margin one micro-step consumes: halo cells PER PHASE."""
+    micro_halo = _MICRO[stencil.name][1]
+    return micro_halo * max(1, len(stencil.phases or ()))
 
 
 def _assemble_window(a, b, c, d):
@@ -231,15 +259,16 @@ def _assemble_window(a, b, c, d):
     return jnp.concatenate([top, bot], axis=0)
 
 
-def _fused_kernel(micro, nfields, k, margin, bz, by, shape, periodic,
-                  interpret, *refs):
+def _fused_kernel(micro, nfields, k, margin, halo, bz, by, shape, periodic,
+                  parity, interpret, *refs):
     """k micro-steps on constant-shape VMEM windows; multi-field generic.
 
     ``refs`` is 4 window blocks per field (core, y-tail, z-tail, corner —
     overlapping BlockSpecs must start block-aligned, hence the assembly),
     then — when ``shape`` is None — 4 blocks of a precomputed frame-mask
-    array, followed by ``nfields`` output blocks.  ``margin = k * halo`` is
-    the temporal-validity margin consumed by the k micro-steps.
+    array, followed by ``nfields`` output blocks.  ``margin = k * halo *
+    phases`` is the temporal-validity margin consumed by the k micro-steps
+    (``_halo_per_micro``); ``halo`` is the stencil's guard-frame width.
 
     ``shape`` carries the global (Z, Y, X) for the single-device case,
     where the frame mask is derived from ``program_id``; the sharded caller
@@ -253,33 +282,45 @@ def _fused_kernel(micro, nfields, k, margin, bz, by, shape, periodic,
     """
     fields = tuple(
         _assemble_window(*refs[4 * f:4 * f + 4]) for f in range(nfields))
+    like = fields[0]
+    extra = ()
     if shape is None:
         frame = _assemble_window(*refs[4 * nfields:4 * nfields + 4]) != 0
         outs = refs[4 * nfields + 4:]
+        if parity:
+            # Block-local parity == global parity: tile extents, the
+            # margin, and every shard origin are even by the alignment
+            # gates (same argument as fullgrid.py's sharded prelude).
+            zi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 0)
+            yi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 1)
+            xi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 2)
+            extra = ((zi + yi + xi) % 2,)
     else:
         outs = refs[4 * nfields:]
+        iz = pl.program_id(0)
+        iy = pl.program_id(1)
+        # Window origin in global coords (input pre-padded by margin
+        # in z/y).
+        z0 = iz * bz - margin
+        y0 = iy * by - margin
+        Z, Y, X = shape
+        zidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 0) + z0
+        yidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 1) + y0
+        xidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 2)
         if periodic:
-            frame = jnp.zeros(fields[0].shape, jnp.bool_)
+            frame = jnp.zeros(like.shape, jnp.bool_)
         else:
-            iz = pl.program_id(0)
-            iy = pl.program_id(1)
-            # Window origin in global coords (input pre-padded by margin
-            # in z/y).
-            z0 = iz * bz - margin
-            y0 = iy * by - margin
-            Z, Y, X = shape
-            halo = margin // k
-            like = fields[0]
-            zidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 0) + z0
-            yidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 1) + y0
-            xidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 2)
             frame = (
                 (zidx < halo) | (zidx >= Z - halo)
                 | (yidx < halo) | (yidx >= Y - halo)
                 | (xidx < halo) | (xidx >= X - halo)
             )
+        if parity:
+            # Global coordinate parity (Z/Y/X are even by tileability, so
+            # the periodic wrap keeps the coloring consistent too).
+            extra = ((zidx + yidx + xidx) % 2,)
     for _ in range(k):
-        fields = micro(fields, frame)
+        fields = micro(fields, frame, *extra)
     for o, f in zip(outs, fields):
         o[...] = f[margin:bz + margin, margin:by + margin, :]
 
@@ -355,8 +396,14 @@ def build_fused_call(
     if interpret is None:
         interpret = _interpret_default()
     micro_factory, halo, nfields = _MICRO[stencil.name]
-    margin = k * halo
+    # margin per micro-step = halo per PHASE (red-black consumes 2*halo)
+    margin = k * _halo_per_micro(stencil)
     Z, Y, X = (int(s) for s in core_shape)
+    if stencil.parity_sensitive and periodic and (X % 2 or Y % 2 or Z % 2):
+        # wrap over an odd extent makes adjacent cells share a color —
+        # the tiling gates force Z/Y even but X (lane axis) is free, so
+        # refuse here exactly as make_sharded_step does
+        return None
     itemsize = jnp.dtype(stencil.dtype).itemsize
     if tiles is None:
         tiles = _pick_tiles(Z, Y, X, margin, itemsize,
@@ -386,8 +433,9 @@ def build_fused_call(
 
     call = pl.pallas_call(
         functools.partial(
-            _fused_kernel, micro, nfields, k, m, bz, by,
-            None if masked else (Z, Y, X), periodic, interpret),
+            _fused_kernel, micro, nfields, k, m, halo, bz, by,
+            None if masked else (Z, Y, X), periodic,
+            stencil.parity_sensitive, interpret),
         grid=grid,
         in_specs=per_field_specs * n_in_sets,
         out_specs=[out_spec] * nfields,

@@ -232,7 +232,8 @@ def make_sharded_fused_step(
     the configuration the 4096^3 north star actually needs (BASELINE.json
     config 5: too big for one chip AND bandwidth-bound).  One call =
 
-      1. width ``k*halo`` halo exchange on the sharded z/y axes (the
+      1. width ``m = k * halo * phases`` halo exchange on the sharded z/y
+         axes (phases = 2 for red-black SOR — fused._halo_per_micro; the
          two-pass axis-wise ``ppermute`` scheme, amortized over k steps —
          k x fewer exchanges than stepping singly), local bc-pad on
          unsharded axes;
@@ -249,10 +250,10 @@ def make_sharded_fused_step(
       * the lane axis x (grid axis 2) unsharded — the kernel's x taps are
         lane rolls of full rows;
       * local z/y extents tileable per ``_pick_tiles`` (multiples of
-        ``2*k*halo``, itself a multiple of the dtype's sublane tile —
+        ``2*m``, itself a multiple of the dtype's sublane tile —
         8 for f32, 16 for bf16: see ``fused._sublane``).
 
-    Every field is exchanged at width ``k*halo`` regardless of
+    Every field is exchanged at width ``m`` regardless of
     ``field_halos`` — temporal blocking consumes spatial margin for ALL
     fields (wave's u_prev is read pointwise across the shrinking validity
     window), so the per-field-halo elision that applies to single steps
@@ -261,7 +262,7 @@ def make_sharded_fused_step(
     from ..ops.pallas.fused import build_fused_call, fused_supported
 
     ndim = stencil.ndim
-    if ndim != 3 or not fused_supported(stencil) or stencil.phases:
+    if ndim != 3 or not fused_supported(stencil):
         return None
     axis_names, counts = _resolve_mesh_axes(ndim, mesh)
     if counts[2] > 1:
@@ -361,8 +362,10 @@ def make_sharded_fullgrid_step(
     # (No parity/odd-extent gate needed for periodic red-black models:
     # the alignment gates in the builder already force even extents.)
     local_shape = tuple(g // c for g, c in zip(global_shape, counts))
+    from ..ops.pallas.fullgrid import _halo_per_micro_2d
+
     # margin per micro-step = halo per PHASE (red-black consumes 2*halo)
-    m = k * stencil.halo * max(1, len(stencil.phases or ()))
+    m = k * _halo_per_micro_2d(stencil)
     built = build_fullgrid_masked_call(
         stencil, (local_shape[0] + 2 * m, local_shape[1]), m, k,
         interpret=interpret, periodic=periodic)

@@ -49,6 +49,8 @@ def test_fused_matches_plain_steps(shape, k):
         ("advect3d", (16, 16, 128), 4, {}),     # asymmetric upwind taps
         ("advect3d", (16, 16, 128), 4,
          {"cx": -0.3, "cy": 0.2, "cz": -0.1}),  # mixed-sign upwinding
+        ("sor3d", (16, 16, 128), 4, {}),        # red-black multi-phase:
+                                                # margin 2*halo per micro
     ],
 )
 def test_fused_families_match_plain_steps(name, shape, k, kw):
@@ -132,6 +134,8 @@ def test_unsupported_configs_return_none():
         pytest.param("advect3d", (16, 16, 128), (2, 1, 1), 4,
                      {"cx": -0.3, "cy": 0.2, "cz": -0.1},
                      marks=pytest.mark.slow),   # asymmetric across shards
+        pytest.param("sor3d", (32, 16, 128), (2, 1, 1), 4, {},
+                     marks=pytest.mark.slow),   # parity across shards
     ],
 )
 def test_sharded_fused_matches_unsharded(name, grid, mesh_shape, k, kw):
