@@ -252,6 +252,10 @@ CONFIGS = [
     ("sor2d_1024_f32_jnp", "sor2d", (1024, 1024), 100, "float32", "jnp"),
     ("sor2d_1024_f32_full16", "sor2d", (1024, 1024), 15, "float32",
      "full16"),
+    # 3D red-black SOR: 2 half-sweeps/step (phase-aware fused margins)
+    ("sor3d_256_f32_jnp", "sor3d", (256, 256, 256), 30, "float32", "jnp"),
+    ("sor3d_256_f32_fused4", "sor3d", (256, 256, 256), 10, "float32",
+     "fused4"),
     # compute_fn z-chunk kernel inside the pad step (M1 kernel, for the
     # record: measured below both jnp and raw — kept as the regression probe
     # for the pad-based pallas integration)
