@@ -380,22 +380,24 @@ def test_fused_step_property(case, z, y, x, k, seed):
     h=hs.sampled_from([8, 15, 16, 24, 100]),
     w=hs.sampled_from([64, 100, 128, 256]),
     k=hs.integers(1, 9),
+    periodic=hs.booleans(),
     seed=hs.integers(0, 2**16),
 )
-def test_fullgrid_step_property(case, h, w, k, seed):
+def test_fullgrid_step_property(case, h, w, k, periodic, seed):
     """make_fullgrid_step either declines (odd shapes) or matches k steps."""
     from mpi_cuda_process_tpu.ops.pallas.fullgrid import make_fullgrid_step
 
     name, kw = case
     st = make_stencil(name, **kw)
     grid = (h, w)
-    full = make_fullgrid_step(st, grid, k, interpret=True)
+    full = make_fullgrid_step(st, grid, k, interpret=True, periodic=periodic)
     if full is None:
         assert h % 8 or w % 128  # aligned shapes this small never decline
         return
-    fields = init_state(st, grid, seed=seed, density=0.3, kind="auto")
+    fields = init_state(st, grid, seed=seed, density=0.3, kind="auto",
+                        periodic=periodic)
     ref = fields
-    step = make_step(st, grid)
+    step = make_step(st, grid, periodic=periodic)
     for _ in range(k):
         ref = step(ref)
     got = full(fields)
