@@ -150,13 +150,12 @@ def test_wave_skips_uprev_exchange_but_stays_correct():
     _compare("wave3d", (8, 8, 8), (2, 2), c2dt2=0.1)
 
 
-@pytest.mark.parametrize("mesh_shape", [
-    (2, 2),
-    # 1-D and 3-axis halo-2 variants: the width-k exchange is additionally
-    # covered mesh-free by test_properties.test_sharded_width_k_halo
-    pytest.param((2,), marks=pytest.mark.slow),
-    pytest.param((2, 2, 2), marks=pytest.mark.slow),
-])
+# Width-2 halo slabs across shard boundaries: the default tier covers the
+# width-k exchange via test_properties.test_sharded_width_k_halo (halo 1/2/3
+# vs numpy) and the halo-2 fused margins via test_fused; the end-to-end
+# heat3d4th mesh ladder is slow tier (a ~46s shard_map compile per shape).
+@pytest.mark.slow
+@pytest.mark.parametrize("mesh_shape", [(2,), (2, 2), (2, 2, 2)])
 def test_heat4th_halo2_sharded(mesh_shape):
     """Width-2 halo slabs across shard boundaries (k>1 exchange path)."""
     _compare("heat3d4th", (8, 8, 8), mesh_shape, alpha=0.05)
