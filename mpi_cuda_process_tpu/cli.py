@@ -20,7 +20,7 @@ import logging
 import math
 import sys
 import time
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
