@@ -110,9 +110,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tol-check-every", type=int, default=10,
                    help="steps between residual checks for --tol")
     p.add_argument("--fuse", type=int, default=0,
-                   help="temporal blocking: advance K steps per HBM pass via "
-                        "the fused Pallas kernel (experimental; measured "
-                        "VPU-bound on v5e fp32 — see ops/pallas/fused.py)")
+                   help="temporal blocking: advance K steps per HBM pass "
+                        "(3D windowed / 2D whole-grid Pallas kernels — the "
+                        "measured-fastest path for heat3d/heat3d27/wave3d, "
+                        "auto-selected there; composes with --mesh, "
+                        "--periodic, and --tol)")
     return p
 
 
