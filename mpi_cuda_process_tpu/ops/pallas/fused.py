@@ -66,6 +66,11 @@ from .kernels import (
 # scratch still fits.
 _VMEM_LIMIT = int(_VMEM_LIMIT_BYTES * 0.8)
 
+# Micro-steps are unrolled up to this k (measured-fast at k=4); deeper
+# blocking runs as a fori_loop to keep the Mosaic program size constant
+# (see _fused_kernel).
+_UNROLL_MAX_K = 4
+
 
 # ---------------------------------------------------------------------------
 # per-stencil micro-steps: (fields-of-windows, frame) -> fields-of-windows.
@@ -319,8 +324,18 @@ def _fused_kernel(micro, nfields, k, margin, halo, bz, by, shape, periodic,
             # Global coordinate parity (Z/Y/X are even by tileability, so
             # the periodic wrap keeps the coloring consistent too).
             extra = ((zidx + yidx + xidx) % 2,)
-    for _ in range(k):
-        fields = micro(fields, frame, *extra)
+    if k > _UNROLL_MAX_K:
+        # Deep temporal blocking as a fori_loop: constant code size.  The
+        # k<=4 unroll is the measured-fast configuration; the bf16-
+        # mandated k=8 (sublane 16 => margin 16) hung the Mosaic compile
+        # when unrolled (results_r03.json heat3d_256_bf16_fused8), and a
+        # loop body is the standard fix for unroll-depth compile blow-up
+        # (the 2D whole-grid kernel uses one for every k).
+        fields = jax.lax.fori_loop(
+            0, k, lambda _, fs: micro(fs, frame, *extra), fields)
+    else:
+        for _ in range(k):
+            fields = micro(fields, frame, *extra)
     for o, f in zip(outs, fields):
         o[...] = f[margin:bz + margin, margin:by + margin, :]
 
