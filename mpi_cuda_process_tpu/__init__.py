@@ -14,7 +14,11 @@ from .ops import advection, heat, life, reaction, sor, wave  # noqa: F401  (regi
 from .ops.stencil import Stencil, available_stencils, make_stencil
 from .parallel.halo import exchange_and_pad
 from .parallel.mesh import make_mesh, spatial_axis_names
-from .parallel.stepper import make_sharded_step, shard_fields
+from .parallel.stepper import (
+    make_sharded_step,
+    make_sharded_temporal_step,
+    shard_fields,
+)
 from .utils.init import init_state, init_state_sharded
 
 __version__ = "0.1.0"
@@ -29,6 +33,7 @@ __all__ = [
     "make_mesh",
     "make_runner",
     "make_sharded_step",
+    "make_sharded_temporal_step",
     "make_stencil",
     "make_step",
     "run_simulation",
