@@ -55,34 +55,100 @@ def _emit(rec) -> None:
         print(json.dumps(rec), flush=True)
 
 
+def _campaign_record():
+    """Headline-equivalent record from the measurement campaign, or None.
+
+    benchmarks/results_r03.json is produced by benchmarks/measure.py with
+    the SAME timing method (N-vs-4N scan difference) on the same chip.
+    Labels are tried in AUTO-PATH priority order — fused4 is the config
+    bench.py's auto path actually runs, the plain jnp label is the
+    fallback — and the first valid one wins (not the largest value).
+    Returns ``(value_mcells, measured_at, label)``.  Never raises: this
+    feeds the watchdog's only output path.
+    """
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "results_r03.json")
+    try:
+        with open(path) as fh:
+            results = json.load(fh)
+        for label in ("heat3d_256_f32_fused4", "heat3d_256_f32"):
+            rec = results.get(label)
+            if not isinstance(rec, dict) or rec.get("suspect"):
+                continue
+            if rec.get("backend") != "tpu":
+                continue
+            value = float(rec["mcells_per_s"])
+            return value, float(rec.get("measured_at") or 0.0), label
+    except Exception:
+        pass
+    return None
+
+
 def _stale_fallback_record():
+    """The watchdog's record when the backend is wedged.  NEVER raises —
+    an exception here would kill the watchdog thread and leave the driver
+    with no output at all."""
     try:
         with open(_CACHE) as fh:
             cached = json.load(fh)
-        age_s = None
-        if cached.get("measured_at"):
-            age_s = round(time.time() - float(cached["measured_at"]), 1)
-        rec = {
-            "metric": cached.get("metric", "stencil_throughput") + "_cached",
-            "value": cached.get("value", 0.0),
-            "unit": cached.get("unit", "Mcells/s"),
-            "vs_baseline": cached.get("vs_baseline", 0.0),
-            "stale": True,
-            "cache_age_s": age_s,
-            "note": (
-                f"STALE: cached {cached.get('backend', 'unknown')}-backend "
-                "result; backend unresponsive this run — not a fresh "
-                "measurement"),
-        }
-        if cached.get("suspect"):  # belt-and-braces: caches predating the
-            rec["suspect"] = True  # no-suspect-writes rule keep their flag
-
+        if not isinstance(cached, dict):
+            cached = None
     except Exception:
-        rec = {"metric": "stencil_throughput_unmeasured",
-               "value": 0.0, "unit": "Mcells/s", "vs_baseline": 0.0,
-               "stale": True,
-               "note": "backend unresponsive; no cached result"}
-    return rec
+        cached = None
+    try:
+        campaign = _campaign_record()
+        # Prefer the NEWER real measurement of the same quantity: the
+        # campaign record (benchmarks/measure.py, same method/chip)
+        # supersedes an older bench cache.  Both replay paths stay
+        # clearly marked stale.
+        cached_at = 0.0
+        if cached is not None:
+            try:
+                cached_at = float(cached.get("measured_at") or 0.0)
+            except (TypeError, ValueError):
+                cached_at = 0.0
+        if campaign is not None and (cached is None
+                                     or campaign[1] > cached_at):
+            value, measured_at, label = campaign
+            return {
+                "metric":
+                    "heat3d_7pt_256cubed_single_chip_throughput_cached",
+                "value": value,
+                "unit": "Mcells/s",
+                "vs_baseline": round(value / BASELINE_MCELLS, 4),
+                "stale": True,
+                "cache_age_s": round(time.time() - measured_at, 1)
+                if measured_at else None,
+                "note": (
+                    "STALE: replayed from the measurement campaign "
+                    f"(benchmarks/results_r03.json[{label}], same N-vs-4N "
+                    "method on the real chip); backend unresponsive this "
+                    "run — not a fresh measurement"),
+            }
+        if cached is not None:
+            age_s = round(time.time() - cached_at, 1) if cached_at else None
+            rec = {
+                "metric": str(cached.get(
+                    "metric", "stencil_throughput")) + "_cached",
+                "value": cached.get("value", 0.0),
+                "unit": cached.get("unit", "Mcells/s"),
+                "vs_baseline": cached.get("vs_baseline", 0.0),
+                "stale": True,
+                "cache_age_s": age_s,
+                "note": (
+                    f"STALE: cached {cached.get('backend', 'unknown')}"
+                    "-backend result; backend unresponsive this run — "
+                    "not a fresh measurement"),
+            }
+            if cached.get("suspect"):  # belt-and-braces: caches predating
+                rec["suspect"] = True  # the no-suspect-writes rule keep it
+            return rec
+    except Exception:
+        pass
+    return {"metric": "stencil_throughput_unmeasured",
+            "value": 0.0, "unit": "Mcells/s", "vs_baseline": 0.0,
+            "stale": True,
+            "note": "backend unresponsive; no usable cached result"}
 
 
 def _watchdog():
