@@ -75,7 +75,10 @@ def _campaign_record():
             rec = results.get(label)
             if not isinstance(rec, dict) or rec.get("suspect"):
                 continue
-            if rec.get("backend") != "tpu":
+            # error-shaped records carry backend but no throughput — skip
+            # to the next label rather than aborting the whole search
+            if rec.get("backend") != "tpu" or \
+                    rec.get("mcells_per_s") is None:
                 continue
             value = float(rec["mcells_per_s"])
             return value, float(rec.get("measured_at") or 0.0), label

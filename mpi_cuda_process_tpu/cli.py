@@ -318,8 +318,6 @@ def build(cfg: RunConfig):
         raise ValueError("--ensemble currently excludes --mesh; "
                          "use one batching strategy at a time")
     if cfg.fuse:
-        if cfg.ensemble:
-            raise ValueError("--fuse currently excludes --ensemble")
         if cfg.compute == "pallas" or cfg.overlap:
             raise ValueError("--fuse replaces the whole step; it excludes "
                              "--compute pallas and --overlap")
@@ -357,6 +355,13 @@ def build(cfg: RunConfig):
                     f"{cfg.grid} (need a fused kernel, 2*k*halo a multiple "
                     f"of the dtype's sublane tile — 8 for f32, 16 for bf16 "
                     f"— and an aligned tiling)")
+        if cfg.ensemble:
+            # N independent universes, each advancing k steps per kernel
+            # pass: vmap adds a leading batch grid dimension to the
+            # pallas_call (per-universe equivalence for both the 2D
+            # whole-grid and 3D windowed kernels —
+            # tests/test_cli.py::test_ensemble_composes_with_fuse{,_3d})
+            fused = driver.make_ensemble_step(fused)
         if resuming:
             fields, start_step = _resume(cfg, fields)
         # fused step_fn advances cfg.fuse steps per call; run() accounts.

@@ -321,3 +321,23 @@ def test_tol_composes_with_fuse():
     # Both must land on the same converged Laplace solution (hot walls).
     np.testing.assert_allclose(
         np.asarray(fused[0]), np.asarray(plain[0]), rtol=0, atol=5e-3)
+
+
+def test_ensemble_composes_with_fuse():
+    """--ensemble N + --fuse K: vmapped temporal blocking, bit-exact per
+    universe against independent unfused runs."""
+    base = dict(stencil="life", grid=(16, 128), iters=8, seed=3,
+                init="random")
+    fused, _ = run(RunConfig(**base, ensemble=3, fuse=4))
+    plain, _ = run(RunConfig(**base, ensemble=3))
+    np.testing.assert_array_equal(np.asarray(fused[0]), np.asarray(plain[0]))
+
+
+def test_ensemble_composes_with_fuse_3d():
+    """The 3D windowed fused kernel under vmap (batched pallas_call grid)."""
+    base = dict(stencil="heat3d", grid=(16, 16, 128), iters=4, seed=1,
+                init="pulse")
+    fused, _ = run(RunConfig(**base, ensemble=2, fuse=4))
+    plain, _ = run(RunConfig(**base, ensemble=2))
+    np.testing.assert_allclose(
+        np.asarray(fused[0]), np.asarray(plain[0]), rtol=0, atol=1e-4)
