@@ -201,8 +201,12 @@ def maybe_auto_fuse(cfg: RunConfig) -> RunConfig:
                 cfg.check_finite, cfg.dump_every]
     if any(v % k for v in cadences if v):
         return cfg
-    from .ops.pallas.fused import make_fused_step
-    if make_fused_step(_make_cfg_stencil(cfg), cfg.grid, k) is None:
+    from .ops.pallas.fused import make_fused_step, prefer_padfree
+    st = _make_cfg_stencil(cfg)
+    # probe the same variant build() will construct (pad-free above the
+    # HBM threshold — the 1024^3 path)
+    if make_fused_step(st, cfg.grid, k,
+                       padfree=prefer_padfree(st, cfg.grid)) is None:
         return cfg  # untileable shape
     log.info("auto: temporal blocking k=%d (fused Pallas kernel)", k)
     return dataclasses.replace(cfg, fuse=k)
@@ -346,9 +350,13 @@ def build(cfg: RunConfig):
                     f"{cfg.grid} (needs a 2D micro family, sublane/lane-"
                     f"aligned extents, and a grid within the VMEM budget)")
         else:
-            from .ops.pallas.fused import make_fused_step
-            fused = make_fused_step(st, cfg.grid, cfg.fuse,
-                                    periodic=cfg.periodic)
+            from .ops.pallas.fused import make_fused_step, prefer_padfree
+            # pad-free (9-block raw-grid) kernel for 1024^3-class grids,
+            # where the padded path's full-grid pad transient exhausts HBM
+            fused = make_fused_step(
+                st, cfg.grid, cfg.fuse, periodic=cfg.periodic,
+                padfree=prefer_padfree(st, cfg.grid,
+                                       batch=cfg.ensemble or 1))
             if fused is None:
                 raise ValueError(
                     f"--fuse {cfg.fuse} unsupported for {st.name} on grid "

@@ -264,6 +264,17 @@ def _assemble_window(a, b, c, d):
     return jnp.concatenate([top, bot], axis=0)
 
 
+def _run_micros(micro, fields, frame, extra, k):
+    """Apply k micro-steps: unrolled for small k, fori_loop beyond
+    (constant Mosaic program size — the bf16 k=8 compile-hang fix)."""
+    if k > _UNROLL_MAX_K:
+        return jax.lax.fori_loop(
+            0, k, lambda _, fs: micro(fs, frame, *extra), fields)
+    for _ in range(k):
+        fields = micro(fields, frame, *extra)
+    return fields
+
+
 def _fused_kernel(micro, nfields, k, margin, halo, bz, by, shape, periodic,
                   parity, interpret, *refs):
     """k micro-steps on constant-shape VMEM windows; multi-field generic.
@@ -302,42 +313,116 @@ def _fused_kernel(micro, nfields, k, margin, halo, bz, by, shape, periodic,
             extra = ((zi + yi + xi) % 2,)
     else:
         outs = refs[4 * nfields:]
-        iz = pl.program_id(0)
-        iy = pl.program_id(1)
         # Window origin in global coords (input pre-padded by margin
         # in z/y).
-        z0 = iz * bz - margin
-        y0 = iy * by - margin
-        Z, Y, X = shape
-        zidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 0) + z0
-        yidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 1) + y0
-        xidx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 2)
-        if periodic:
-            frame = jnp.zeros(like.shape, jnp.bool_)
-        else:
-            frame = (
-                (zidx < halo) | (zidx >= Z - halo)
-                | (yidx < halo) | (yidx >= Y - halo)
-                | (xidx < halo) | (xidx >= X - halo)
-            )
-        if parity:
-            # Global coordinate parity (Z/Y/X are even by tileability, so
-            # the periodic wrap keeps the coloring consistent too).
-            extra = ((zidx + yidx + xidx) % 2,)
-    if k > _UNROLL_MAX_K:
-        # Deep temporal blocking as a fori_loop: constant code size.  The
-        # k<=4 unroll is the measured-fast configuration; the bf16-
-        # mandated k=8 (sublane 16 => margin 16) hung the Mosaic compile
-        # when unrolled (results_r03.json heat3d_256_bf16_fused8), and a
-        # loop body is the standard fix for unroll-depth compile blow-up
-        # (the 2D whole-grid kernel uses one for every k).
-        fields = jax.lax.fori_loop(
-            0, k, lambda _, fs: micro(fs, frame, *extra), fields)
-    else:
-        for _ in range(k):
-            fields = micro(fields, frame, *extra)
+        frame, extra = _window_frame(
+            like.shape, pl.program_id(0) * bz - margin,
+            pl.program_id(1) * by - margin, shape, halo, periodic, parity)
+    # k<=4 unrolls (measured-fast); deeper k runs as a fori_loop — the
+    # unrolled bf16 k=8 hung the Mosaic compile (results_r03.json
+    # heat3d_256_bf16_fused8), and a loop body keeps program size constant.
+    fields = _run_micros(micro, fields, frame, extra, k)
     for o, f in zip(outs, fields):
         o[...] = f[margin:bz + margin, margin:by + margin, :]
+
+
+def _window_frame(win_shape, z0, y0, shape, halo, periodic, parity):
+    """(frame mask, parity extra) for a window whose global origin is
+    (z0, y0, 0).  Shared by the padded and pad-free kernels — the single
+    definition of the guard-frame predicate and the red-black coloring.
+
+    Global coordinate parity: Z/Y/X are even by the tileability gates, so
+    the periodic wrap keeps the coloring consistent; jnp's ``%`` is a
+    floor-mod, so ghost coords (zidx < 0) color as Z+zidx — consistent
+    with the wrap, and irrelevant in guard-frame mode (ghosts are pinned).
+    """
+    Z, Y, X = shape
+    zidx = jax.lax.broadcasted_iota(jnp.int32, win_shape, 0) + z0
+    yidx = jax.lax.broadcasted_iota(jnp.int32, win_shape, 1) + y0
+    xidx = jax.lax.broadcasted_iota(jnp.int32, win_shape, 2)
+    if periodic:
+        frame = jnp.zeros(win_shape, jnp.bool_)
+    else:
+        frame = (
+            (zidx < halo) | (zidx >= Z - halo)
+            | (yidx < halo) | (yidx >= Y - halo)
+            | (xidx < halo) | (xidx >= X - halo)
+        )
+    extra = ((zidx + yidx + xidx) % 2,) if parity else ()
+    return frame, extra
+
+
+def _assemble_window3x3(refs):
+    rows = [jnp.concatenate([b[...] for b in refs[r * 3:r * 3 + 3]], axis=1)
+            for r in range(3)]
+    return jnp.concatenate(rows, axis=0)
+
+
+def _fused_raw_kernel(micro, nfields, k, margin, halo, bz, by, shape,
+                      periodic, parity, interpret, *refs):
+    """Pad-free variant of ``_fused_kernel``: the window is assembled from
+    NINE blocks of the RAW grid (3x3: pre/core/post in z and y, tail
+    granularity ``2*margin``) instead of four blocks of a z/y-padded copy —
+    so no full-grid pad transient ever materializes.  At 1024^3 f32 the
+    padded path's extra ~4.3 GiB copy was the RESOURCE_EXHAUSTED
+    (results_r03.json heat3d_1024_f32_fused4); pad-free needs only the two
+    state buffers.
+
+    The assembled window carries margin ``2*margin`` per side (overlapping
+    BlockSpecs must start block-aligned, and the window origin sits at
+    ``i*bz - 2m`` which is only ``2m``-aligned) — one extra margin of
+    redundant compute; temporal validity needs only ``margin``.
+
+    Boundary semantics: non-periodic wall tiles CLAMP their pre/post specs
+    to the wall block, so out-of-domain ghost cells hold in-domain garbage
+    rather than pad zeros.  That is safe for exactly the reason the padded
+    kernel's ghost pinning is: ghosts satisfy the frame predicate, are
+    re-pinned every micro-step, and only ever feed updates of OTHER pinned
+    cells (interior outputs tap at most ``halo`` past the guard frame,
+    never a ghost).  Periodic tiles WRAP their pre/post block indices
+    instead, which reproduces the wrap-pad values exactly.
+    """
+    wm = 2 * margin
+    fields = tuple(
+        _assemble_window3x3(refs[9 * f:9 * f + 9]) for f in range(nfields))
+    like = fields[0]
+    outs = refs[9 * nfields:]
+    frame, extra = _window_frame(
+        like.shape, pl.program_id(0) * bz - wm, pl.program_id(1) * by - wm,
+        shape, halo, periodic, parity)
+    fields = _run_micros(micro, fields, frame, extra, k)
+    for o, f in zip(outs, fields):
+        o[...] = f[wm:bz + wm, wm:by + wm, :]
+
+
+def _raw_window_specs(Z, Y, X, bz, by, m, periodic):
+    """Nine BlockSpecs assembling one (bz+4m, by+4m, X) window from the raw
+    grid.  Tail blocks have granularity g=2m (block-aligned origins); wall
+    tiles clamp (guard-frame mode) or wrap (periodic) their indices."""
+    g = 2 * m
+    nzb, nyb = Z // g, Y // g
+    rz, ry = bz // g, by // g
+    if periodic:
+        zp = lambda i: (i * rz - 1) % nzb          # noqa: E731
+        zn = lambda i: ((i + 1) * rz) % nzb        # noqa: E731
+        yp = lambda j: (j * ry - 1) % nyb          # noqa: E731
+        yn = lambda j: ((j + 1) * ry) % nyb        # noqa: E731
+    else:
+        zp = lambda i: jnp.maximum(i * rz - 1, 0)              # noqa: E731
+        zn = lambda i: jnp.minimum((i + 1) * rz, nzb - 1)      # noqa: E731
+        yp = lambda j: jnp.maximum(j * ry - 1, 0)              # noqa: E731
+        yn = lambda j: jnp.minimum((j + 1) * ry, nyb - 1)      # noqa: E731
+    return [
+        pl.BlockSpec((g, g, X), lambda i, j: (zp(i), yp(j), 0)),
+        pl.BlockSpec((g, by, X), lambda i, j: (zp(i), j, 0)),
+        pl.BlockSpec((g, g, X), lambda i, j: (zp(i), yn(j), 0)),
+        pl.BlockSpec((bz, g, X), lambda i, j: (i, yp(j), 0)),
+        pl.BlockSpec((bz, by, X), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((bz, g, X), lambda i, j: (i, yn(j), 0)),
+        pl.BlockSpec((g, g, X), lambda i, j: (zn(i), yp(j), 0)),
+        pl.BlockSpec((g, by, X), lambda i, j: (zn(i), j, 0)),
+        pl.BlockSpec((g, g, X), lambda i, j: (zn(i), yn(j), 0)),
+    ]
 
 
 def _lane_round(n: int) -> int:
@@ -350,8 +435,15 @@ def _sublane(itemsize: int) -> int:
 
 
 def _pick_tiles(Z: int, Y: int, X: int, margin: int, itemsize: int,
-                nfields: int):
-    """Choose (bz, by) dividing (Z, Y), multiples of 2*margin, fitting VMEM."""
+                nfields: int, wm: Optional[int] = None):
+    """Choose (bz, by) dividing (Z, Y), multiples of 2*margin, fitting VMEM.
+
+    ``wm`` is the per-side WINDOW margin the kernel actually assembles
+    (``margin`` for the padded 4-block kernel, ``2*margin`` for the
+    pad-free 9-block kernel); the VMEM budget is computed from it.
+    """
+    if wm is None:
+        wm = margin
     if (2 * margin) % _sublane(itemsize):
         # Tail blocks are (2m, by, X) / (bz, 2m, X) at offsets that are
         # multiples of 2m: both their size and their origin must be
@@ -369,7 +461,7 @@ def _pick_tiles(Z: int, Y: int, X: int, margin: int, itemsize: int,
         for by in (64, 32, 16, 8):
             if Z % bz or Y % by or bz % (2 * margin) or by % (2 * margin):
                 continue
-            window = ((bz + 2 * margin) * (by + 2 * margin)
+            window = ((bz + 2 * wm) * (by + 2 * wm)
                       * _lane_round(X) * itemsize)
             core = bz * by * _lane_round(X) * itemsize
             # ~7 live window copies per field (pipeline buffers + the
@@ -387,6 +479,33 @@ def fused_supported(stencil: Stencil) -> bool:
     return stencil.name in _MICRO
 
 
+# The padded 4-block kernel holds ~3 full grids live per field (input, z/y-
+# padded transient, output) while the pad copy runs; past this many bytes
+# the 9-block pad-free kernel is selected instead (v5e HBM is 16 GiB; the
+# padded path's transient was the 1024^3 f32 RESOURCE_EXHAUSTED,
+# results_r03.json).  Below it the padded kernel stays the default — it is
+# the measured 107 Gcells/s configuration — until the campaign measures
+# pad-free at 256^3/512^3 (labels *_padfree4 in benchmarks/measure.py).
+_PADFREE_ABOVE_BYTES = 6 * 1024**3
+
+
+def prefer_padfree(stencil: Stencil, global_shape: Sequence[int],
+                   batch: int = 1) -> bool:
+    """Whether ``make_fused_step`` callers should pick the pad-free kernel.
+
+    ``batch``: ensemble factor — a vmapped step_k batches the pad
+    transient too, so the live-bytes estimate scales with it.
+    """
+    if stencil.name not in _MICRO:
+        return False
+    nfields = _MICRO[stencil.name][2]
+    cells = max(1, int(batch))
+    for s in global_shape:
+        cells *= int(s)
+    live = 3 * cells * jnp.dtype(stencil.dtype).itemsize * nfields
+    return live > _PADFREE_ABOVE_BYTES
+
+
 def build_fused_call(
     stencil: Stencil,
     core_shape: Tuple[int, int, int],
@@ -395,6 +514,7 @@ def build_fused_call(
     interpret: Optional[bool] = None,
     masked: bool = False,
     periodic: bool = False,
+    padfree: bool = False,
 ):
     """Construct the fused pallas_call over a (core) block of ``core_shape``.
 
@@ -405,8 +525,15 @@ def build_fused_call(
     ``core_shape``.  ``masked=False`` derives the mask from program ids and
     the global shape (single-device use); ``masked=True`` is for callers
     whose blocks sit at a traced global offset (shard_map).
+
+    ``padfree=True`` builds the 9-block raw-grid kernel instead (see
+    ``_fused_raw_kernel``): the call takes 9 views of the UNPADDED field
+    (pass it 9 times) and no pad transient is needed.  Incompatible with
+    ``masked`` (the sharded caller pads its local block, which is small).
     """
     if not fused_supported(stencil):
+        return None
+    if padfree and masked:
         return None
     if interpret is None:
         interpret = _interpret_default()
@@ -422,7 +549,8 @@ def build_fused_call(
     itemsize = jnp.dtype(stencil.dtype).itemsize
     if tiles is None:
         tiles = _pick_tiles(Z, Y, X, margin, itemsize,
-                            nfields + (1 if masked else 0))
+                            nfields + (1 if masked else 0),
+                            wm=2 * margin if padfree else None)
     if tiles is None:
         return None
     bz, by = tiles
@@ -430,27 +558,36 @@ def build_fused_call(
 
     grid = (Z // bz, Y // by)
     m = margin
-    # Four aligned views of the z/y-padded input reassemble each program's
-    # overlapping (bz+2m, by+2m, X) window; alignment needs bz, by % 2m == 0.
-    per_field_specs = [
-        pl.BlockSpec((bz, by, X), lambda i, j: (i, j, 0)),
-        pl.BlockSpec(
-            (bz, 2 * m, X), lambda i, j: (i, (j + 1) * by // (2 * m), 0)),
-        pl.BlockSpec(
-            (2 * m, by, X), lambda i, j: ((i + 1) * bz // (2 * m), j, 0)),
-        pl.BlockSpec(
-            (2 * m, 2 * m, X),
-            lambda i, j: ((i + 1) * bz // (2 * m),
-                          (j + 1) * by // (2 * m), 0)),
-    ]
-    out_spec = pl.BlockSpec((bz, by, X), lambda i, j: (i, j, 0))
-    n_in_sets = nfields + (1 if masked else 0)
-
-    call = pl.pallas_call(
-        functools.partial(
+    if padfree:
+        per_field_specs = _raw_window_specs(Z, Y, X, bz, by, m, periodic)
+        kernel = functools.partial(
+            _fused_raw_kernel, micro, nfields, k, m, halo, bz, by,
+            (Z, Y, X), periodic, stencil.parity_sensitive, interpret)
+        n_in_sets = nfields
+    else:
+        # Four aligned views of the z/y-padded input reassemble each
+        # program's overlapping (bz+2m, by+2m, X) window; alignment needs
+        # bz, by % 2m == 0.
+        per_field_specs = [
+            pl.BlockSpec((bz, by, X), lambda i, j: (i, j, 0)),
+            pl.BlockSpec(
+                (bz, 2 * m, X), lambda i, j: (i, (j + 1) * by // (2 * m), 0)),
+            pl.BlockSpec(
+                (2 * m, by, X), lambda i, j: ((i + 1) * bz // (2 * m), j, 0)),
+            pl.BlockSpec(
+                (2 * m, 2 * m, X),
+                lambda i, j: ((i + 1) * bz // (2 * m),
+                              (j + 1) * by // (2 * m), 0)),
+        ]
+        kernel = functools.partial(
             _fused_kernel, micro, nfields, k, m, halo, bz, by,
             None if masked else (Z, Y, X), periodic,
-            stencil.parity_sensitive, interpret),
+            stencil.parity_sensitive, interpret)
+        n_in_sets = nfields + (1 if masked else 0)
+    out_spec = pl.BlockSpec((bz, by, X), lambda i, j: (i, j, 0))
+
+    call = pl.pallas_call(
+        kernel,
         grid=grid,
         in_specs=per_field_specs * n_in_sets,
         out_specs=[out_spec] * nfields,
@@ -471,6 +608,7 @@ def make_fused_step(
     tiles: Optional[Tuple[int, int]] = None,
     interpret: Optional[bool] = None,
     periodic: bool = False,
+    padfree: bool = False,
 ):
     """Build ``fields -> fields`` advancing ``k`` steps in one kernel pass.
 
@@ -483,13 +621,26 @@ def make_fused_step(
     ``2 * k * halo`` must be a multiple of the dtype's sublane tile (8 for
     f32, 16 for bf16 — see ``_sublane``), i.e. f32 halo-1 needs k in
     {4, 8, ...}, bf16 halo-1 needs k in {8, 16, ...}.
+
+    ``padfree=True`` selects the 9-block raw-grid kernel: no z/y pad
+    transient is materialized (required for 1024^3-class grids, where the
+    padded path's extra full-grid copy exhausts HBM), at the cost of one
+    extra margin of overlap redundancy per side.
     """
     built = build_fused_call(
         stencil, tuple(int(s) for s in global_shape), k, tiles, interpret,
-        periodic=periodic)
+        periodic=periodic, padfree=padfree)
     if built is None:
         return None
     call, m, _ = built
+
+    if padfree:
+        def step_k(fields: Fields) -> Fields:
+            args = [f for f in fields for _ in range(9)]
+            return tuple(call(*args))
+
+        return step_k
+
     pad_mode = "wrap" if periodic else "constant"
 
     def step_k(fields: Fields) -> Fields:

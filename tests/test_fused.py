@@ -205,3 +205,88 @@ def test_sharded_fused_periodic_matches_plain():
     assert fused is not None
     got = jax.jit(fused)(shard_fields(fields, mesh, 3))
     assert jnp.allclose(got[0], ref[0], rtol=0, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# pad-free (9-block raw-grid) variant: no full-grid pad transient
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "name,shape,k,kw",
+    [
+        ("heat3d", (16, 16, 128), 4, {}),
+        ("heat3d", (32, 16, 128), 8, {}),       # fori_loop depth
+        ("heat3d4th", (16, 16, 128), 2, {}),    # halo 2
+        ("wave3d", (16, 16, 128), 4, {}),       # two-field carry
+        ("grayscott3d", (16, 16, 128), 4, {}),  # both fields halo'd
+        ("advect3d", (16, 16, 128), 4,
+         {"cx": -0.3, "cy": 0.2, "cz": -0.1}),  # mixed-sign upwinding
+        ("sor3d", (16, 16, 128), 4, {}),        # parity from ghost coords
+    ],
+)
+def test_padfree_matches_plain_steps(name, shape, k, kw):
+    st = make_stencil(name, **kw)
+    fields = init_state(st, shape, seed=7, kind="pulse")
+    step = jax.jit(make_step(st, shape))
+    ref = fields
+    for _ in range(k):
+        ref = step(ref)
+    fused = make_fused_step(st, shape, k, interpret=True, padfree=True)
+    assert fused is not None
+    out = jax.jit(fused)(fields)
+    assert len(out) == len(ref)
+    for o, r in zip(out, ref):
+        assert jnp.allclose(o, r, rtol=0, atol=1e-4), name
+    # guard frame verbatim (ghost clamp garbage must never leak inward)
+    for o, r in zip(out, ref):
+        for d in range(3):
+            for sl in (slice(0, st.halo), slice(-st.halo, None)):
+                idx = [slice(None)] * 3
+                idx[d] = sl
+                assert jnp.array_equal(o[tuple(idx)], r[tuple(idx)])
+
+
+def test_padfree_bitexact_vs_padded():
+    """Same tap order as the padded fused kernel => bit-exact match."""
+    st = make_stencil("heat3d")
+    shape = (16, 16, 128)
+    fields = init_state(st, shape, seed=11, kind="random")
+    padded = make_fused_step(st, shape, 4, interpret=True)
+    padfree = make_fused_step(st, shape, 4, interpret=True, padfree=True)
+    assert padded is not None and padfree is not None
+    a = jax.jit(padded)(fields)
+    b = jax.jit(padfree)(fields)
+    assert jnp.array_equal(a[0], b[0])
+
+
+def test_padfree_periodic_matches_plain_steps():
+    """Periodic pad-free: wrapped block indices == wrap-pad values."""
+    st = make_stencil("heat3d")
+    shape = (16, 16, 128)
+    fields = init_state(st, shape, seed=4, kind="random", periodic=True)
+    step = jax.jit(make_step(st, shape, periodic=True))
+    ref = fields
+    for _ in range(4):
+        ref = step(ref)
+    fused = make_fused_step(st, shape, 4, interpret=True, periodic=True,
+                            padfree=True)
+    assert fused is not None
+    out = jax.jit(fused)(fields)
+    assert jnp.allclose(out[0], ref[0], rtol=0, atol=1e-4)
+
+
+def test_padfree_periodic_sor_parity():
+    """Red-black coloring stays globally consistent across wrapped tiles."""
+    st = make_stencil("sor3d")
+    shape = (16, 16, 128)
+    fields = init_state(st, shape, seed=6, kind="pulse", periodic=True)
+    step = jax.jit(make_step(st, shape, periodic=True))
+    ref = fields
+    for _ in range(4):
+        ref = step(ref)
+    fused = make_fused_step(st, shape, 4, interpret=True, periodic=True,
+                            padfree=True)
+    assert fused is not None
+    out = jax.jit(fused)(fields)
+    assert jnp.allclose(out[0], ref[0], rtol=0, atol=1e-4)
