@@ -29,8 +29,11 @@ _CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # .claude/skills/verify/SKILL.md).  A watchdog emits a clearly-marked STALE
 # record (distinct metric name + ``stale: true`` + cache age) rather than
 # letting the driver's bench run record nothing — stale data must never be
-# scorable as a fresh measurement.  The seeded .bench_cache.json is committed
-# deliberately: it is the last-known-good measured record the fallback cites.
+# scorable as a fresh measurement.  Replay is restricted to cache records
+# THIS machine's bench actually measured (``local_run: true``, written by
+# main() below): a fresh checkout with a wedged backend reports value 0.0
+# and cites the committed campaign table in the note instead of replaying
+# VCS data as if it were a local measurement (round-3 advisor finding).
 # The watchdog is progress-aware: it fires only after _WATCHDOG_S seconds
 # with NO progress (a slow-but-advancing run keeps extending its lease).
 _WATCHDOG_S = 420.0
@@ -55,80 +58,29 @@ def _emit(rec) -> None:
         print(json.dumps(rec), flush=True)
 
 
-def _campaign_record():
-    """Headline-equivalent record from the measurement campaign, or None.
-
-    benchmarks/results_r03.json is produced by benchmarks/measure.py with
-    the SAME timing method (N-vs-4N scan difference) on the same chip.
-    Labels are tried in AUTO-PATH priority order — fused4 is the config
-    bench.py's auto path actually runs, the plain jnp label is the
-    fallback — and the first valid one wins (not the largest value).
-    Returns ``(value_mcells, measured_at, label)``.  Never raises: this
-    feeds the watchdog's only output path.
-    """
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "benchmarks", "results_r03.json")
-    try:
-        with open(path) as fh:
-            results = json.load(fh)
-        for label in ("heat3d_256_f32_fused4", "heat3d_256_f32"):
-            rec = results.get(label)
-            if not isinstance(rec, dict) or rec.get("suspect"):
-                continue
-            # error-shaped records carry backend but no throughput — skip
-            # to the next label rather than aborting the whole search
-            if rec.get("backend") != "tpu" or \
-                    rec.get("mcells_per_s") is None:
-                continue
-            value = float(rec["mcells_per_s"])
-            return value, float(rec.get("measured_at") or 0.0), label
-    except Exception:
-        pass
-    return None
-
-
 def _stale_fallback_record():
     """The watchdog's record when the backend is wedged.  NEVER raises —
     an exception here would kill the watchdog thread and leave the driver
-    with no output at all."""
+    with no output at all.
+
+    Only a cache record THIS machine measured (``local_run: true``) is
+    replayed as a value; anything else yields value 0.0 with a pointer at
+    the committed campaign table — VCS data must not impersonate a local
+    measurement (round-3 advisor finding on _campaign_record).
+    """
     try:
         with open(_CACHE) as fh:
             cached = json.load(fh)
-        if not isinstance(cached, dict):
+        if not isinstance(cached, dict) or not cached.get("local_run"):
             cached = None
     except Exception:
         cached = None
     try:
-        campaign = _campaign_record()
-        # Prefer the NEWER real measurement of the same quantity: the
-        # campaign record (benchmarks/measure.py, same method/chip)
-        # supersedes an older bench cache.  Both replay paths stay
-        # clearly marked stale.
-        cached_at = 0.0
         if cached is not None:
             try:
                 cached_at = float(cached.get("measured_at") or 0.0)
             except (TypeError, ValueError):
                 cached_at = 0.0
-        if campaign is not None and (cached is None
-                                     or campaign[1] > cached_at):
-            value, measured_at, label = campaign
-            return {
-                "metric":
-                    "heat3d_7pt_256cubed_single_chip_throughput_cached",
-                "value": value,
-                "unit": "Mcells/s",
-                "vs_baseline": round(value / BASELINE_MCELLS, 4),
-                "stale": True,
-                "cache_age_s": round(time.time() - measured_at, 1)
-                if measured_at else None,
-                "note": (
-                    "STALE: replayed from the measurement campaign "
-                    f"(benchmarks/results_r03.json[{label}], same N-vs-4N "
-                    "method on the real chip); backend unresponsive this "
-                    "run — not a fresh measurement"),
-            }
-        if cached is not None:
             age_s = round(time.time() - cached_at, 1) if cached_at else None
             rec = {
                 "metric": str(cached.get(
@@ -140,8 +92,9 @@ def _stale_fallback_record():
                 "cache_age_s": age_s,
                 "note": (
                     f"STALE: cached {cached.get('backend', 'unknown')}"
-                    "-backend result; backend unresponsive this run — "
-                    "not a fresh measurement"),
+                    "-backend result measured by a previous LOCAL bench "
+                    "run; backend unresponsive this run — not a fresh "
+                    "measurement"),
             }
             if cached.get("suspect"):  # belt-and-braces: caches predating
                 rec["suspect"] = True  # the no-suspect-writes rule keep it
@@ -151,7 +104,9 @@ def _stale_fallback_record():
     return {"metric": "stencil_throughput_unmeasured",
             "value": 0.0, "unit": "Mcells/s", "vs_baseline": 0.0,
             "stale": True,
-            "note": "backend unresponsive; no usable cached result"}
+            "note": ("backend unresponsive and no local bench cache; see "
+                     "benchmarks/results_r0*.json for the measurement "
+                     "campaign's real-chip table (not replayed here)")}
 
 
 def _watchdog():
@@ -306,7 +261,8 @@ def main():
             tmp = _CACHE + ".tmp"
             with open(tmp, "w") as fh:
                 json.dump(
-                    {**rec, "backend": backend, "measured_at": time.time()},
+                    {**rec, "backend": backend, "measured_at": time.time(),
+                     "local_run": True},
                     fh)
             os.replace(tmp, _CACHE)
         except OSError:

@@ -43,6 +43,7 @@ class RunConfig:
     tol_check_every: int = 10  # residual check cadence for --tol
     dump_every: int = 0  # >0: async .npy snapshots of field0 every N steps
     dump_dir: Optional[str] = None
+    mem_check: str = "error"  # error | warn | off: per-device HBM budget guard
     params: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:

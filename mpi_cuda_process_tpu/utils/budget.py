@@ -1,0 +1,171 @@
+"""Per-device HBM budget estimation: refuse with arithmetic, don't OOM.
+
+The reference has no memory accounting at all — it mallocs the FULL grid on
+every rank (storage replicated, kernel.cu:184-191) and checks no return
+code, so an over-size grid dies wherever the first allocation fails.  At
+this framework's north-star scale (BASELINE config 5: 4096^3 wave,
+2 x 256 GiB double-buffered f32) an unchecked launch costs minutes of
+compile + transfer before a RESOURCE_EXHAUSTED with no actionable
+breakdown.  This module computes the peak per-device live bytes for the
+run's EXECUTION STRATEGY up front and raises a ValueError that shows the
+arithmetic, so a config that cannot fit fails in milliseconds with the
+numbers in hand (e.g.: config 5 needs bf16 — f32 state alone is
+3 x 4 GiB/device on 64 chips before exchange transients).
+
+The estimate is deliberately coarse-but-conservative: it models the
+dominant full-field buffers (state, scan double-buffer transient, pad /
+exchange-pad copies, the sharded fused mask) and adds a fractional
+overhead for XLA workspace + Pallas pipeline scratch.  It is an upper
+bound on the framework's own allocations, not a simulator of XLA's
+scheduler.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Fractional slack for XLA workspace, Pallas pipeline buffers (a few
+# (bz+2m, by+2m, X) VMEM-to-HBM staging copies), and allocator rounding.
+_OVERHEAD_FRAC = 0.10
+
+# v5e HBM when the backend doesn't report a limit.
+_DEFAULT_HBM_BYTES = 16 * 1024**3
+
+
+def device_hbm_bytes() -> int:
+    """Per-device HBM capacity: backend-reported when available."""
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:  # noqa: BLE001 — stats are best-effort everywhere
+        pass
+    return _DEFAULT_HBM_BYTES
+
+
+def _local_shape(grid: Sequence[int], mesh: Sequence[int]) -> Tuple[int, ...]:
+    counts = tuple(mesh) + (1,) * (len(grid) - len(mesh)) if mesh else \
+        (1,) * len(grid)
+    return tuple(int(g) // int(c) for g, c in zip(grid, counts))
+
+
+def estimate_run_bytes(
+    stencil,
+    grid: Sequence[int],
+    mesh: Sequence[int] = (),
+    fuse: int = 0,
+    ensemble: int = 0,
+    periodic: bool = False,
+    compute: str = "auto",
+) -> Tuple[int, List[Tuple[str, int]]]:
+    """Peak per-device live bytes for a run, with a labeled breakdown.
+
+    Mirrors ``cli.build``'s strategy selection coarsely: temporal blocking
+    (``fuse``) on its padded / pad-free / sharded-masked variants, the raw
+    whole-step kernels (no transient: the state is its own halo), and the
+    jnp pad -> update path.  Returns ``(total, [(label, bytes), ...])``.
+    """
+    itemsize = jnp.dtype(stencil.dtype).itemsize
+    nfields = stencil.num_fields
+    batch = max(1, int(ensemble))
+    local = _local_shape(grid, mesh)
+    cells = batch * math.prod(local)
+    field_b = cells * itemsize
+    halo = stencil.halo
+
+    parts: List[Tuple[str, int]] = [
+        (f"state: {nfields} field(s) x {field_b / 2**30:.2f} GiB "
+         f"({'x'.join(str(s) for s in local)} local, {stencil.dtype})",
+         nfields * field_b),
+        # donated scan carry: the new state is written before the old
+        # buffer is released — one extra field of transient
+        ("step output transient (donated double buffer)", field_b),
+    ]
+
+    sharded = bool(mesh) and math.prod(mesh) > 1
+    if fuse and len(local) == 3:
+        from ..ops.pallas.fused import _halo_per_micro, prefer_padfree
+
+        m = fuse * _halo_per_micro(stencil)
+        lz, ly, lx = local
+        padded_b = batch * (lz + 2 * m) * (ly + 2 * m) * lx * itemsize
+        if sharded:
+            # exchange-padded local block per field + (non-periodic) the
+            # frame-mask array, same padded shape (stepper.py local_step)
+            n_pad = nfields + (0 if periodic else 1)
+            parts.append(
+                (f"sharded fused: {n_pad} exchange-padded block(s) "
+                 f"(+{2 * m} z/y)", n_pad * padded_b))
+        elif prefer_padfree(stencil, grid, batch=batch):
+            parts.append(("pad-free fused: no pad transient", 0))
+        else:
+            parts.append(
+                (f"fused pad transient (+{2 * m} z/y) x {nfields}",
+                 nfields * padded_b))
+    elif fuse and len(local) == 2:
+        m = fuse * halo * max(1, len(stencil.phases or ()))
+        ly, lx = local
+        padded_b = batch * (ly + 2 * m) * lx * itemsize
+        parts.append((f"2D fullgrid pad transient (+{2 * m} rows)",
+                      nfields * padded_b))
+    elif compute == "raw":
+        # whole-step raw kernels: the state is its own halo — no transient
+        # (callers pass compute="raw" when the run will actually take that
+        # path; see cli._check_mem_budget)
+        parts.append(("raw whole-step kernel: no pad transient", 0))
+    else:
+        # jnp pad -> update -> re-pin: one padded copy per halo'd field
+        # (exchange-padded under a mesh: +2*halo on each sharded axis)
+        pad = 2 * halo
+        parts.append(
+            (f"pad transient (+{pad} per axis) x {nfields}",
+             nfields * batch
+             * math.prod(s + pad for s in local) * itemsize))
+
+    subtotal = sum(b for _, b in parts)
+    overhead = int(subtotal * _OVERHEAD_FRAC)
+    parts.append((f"workspace overhead ({int(_OVERHEAD_FRAC * 100)}%)",
+                  overhead))
+    return subtotal + overhead, parts
+
+
+def format_budget(total: int, parts: List[Tuple[str, int]],
+                  hbm: int) -> str:
+    lines = [f"  {b / 2**30:7.2f} GiB  {label}" for label, b in parts]
+    lines.append(f"  {total / 2**30:7.2f} GiB  TOTAL per device "
+                 f"(HBM capacity {hbm / 2**30:.2f} GiB)")
+    return "\n".join(lines)
+
+
+def check_budget(
+    stencil,
+    grid: Sequence[int],
+    mesh: Sequence[int] = (),
+    fuse: int = 0,
+    ensemble: int = 0,
+    periodic: bool = False,
+    compute: str = "auto",
+    hbm_bytes: Optional[int] = None,
+) -> Tuple[int, List[Tuple[str, int]]]:
+    """Raise ValueError with the arithmetic when the run cannot fit.
+
+    Returns the estimate when it fits (callers may log it).
+    """
+    hbm = hbm_bytes if hbm_bytes is not None else device_hbm_bytes()
+    total, parts = estimate_run_bytes(
+        stencil, grid, mesh=mesh, fuse=fuse, ensemble=ensemble,
+        periodic=periodic, compute=compute)
+    if total > hbm:
+        raise ValueError(
+            f"config needs ~{total / 2**30:.2f} GiB per device but HBM is "
+            f"{hbm / 2**30:.2f} GiB; refusing before compile. Breakdown:\n"
+            + format_budget(total, parts, hbm)
+            + "\nLevers: --dtype bfloat16 halves state bytes; a larger "
+            "--mesh shrinks the per-device block; --fuse on a "
+            f"{'pad-free eligible' if not mesh else 'sharded'} grid avoids "
+            "pad transients; --mem-check warn overrides this guard.")
+    return total, parts

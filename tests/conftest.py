@@ -16,11 +16,31 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from cpuforce import force_cpu  # noqa: E402
 
-# Leave an explicit pre-set device count untouched so an outer harness can
-# choose its own count via XLA_FLAGS.
-_n = (
-    None
-    if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", "")
-    else 8
-)
-force_cpu(_n)
+# TPU smoke tier (docs/STATE.md runbook step 5): `TPU_SMOKE=1 pytest -m tpu`
+# leaves the real backend in place so the smoke tests exercise the actual
+# chip.  BOTH signals are required — the env var alone must not flip a
+# plain `pytest tests -q` (with TPU_SMOKE still exported) onto the real
+# backend, so the decision lives in pytest_configure where the final -m
+# expression is known.  force_cpu there still precedes every test import
+# (configure runs before collection), which is early enough for the
+# backend override.
+
+
+def _tpu_tier_selected(config) -> bool:
+    markexpr = getattr(config.option, "markexpr", "") or ""
+    return bool(os.environ.get("TPU_SMOKE")) and \
+        "tpu" in markexpr and "not tpu" not in markexpr
+
+
+def pytest_configure(config):
+    if _tpu_tier_selected(config):
+        return  # real backend stays for the -m tpu smoke tier
+    # Leave an explicit pre-set device count untouched so an outer harness
+    # can choose its own count via XLA_FLAGS.
+    _n = (
+        None
+        if "xla_force_host_platform_device_count"
+        in os.environ.get("XLA_FLAGS", "")
+        else 8
+    )
+    force_cpu(_n)

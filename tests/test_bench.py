@@ -117,22 +117,41 @@ def test_scaling_fused_smoke(scaling, capsys):
         assert rec["mcells_per_s"] > 0
 
 
-def test_stale_fallback_prefers_newer_campaign_record(bench, tmp_path):
-    """The wedged-backend replay serves the NEWEST real measurement of the
-    headline quantity: the campaign's fused4 record supersedes an older
-    bench cache, stays stale-marked, and never raises on corrupt caches."""
-    rec = bench._stale_fallback_record()
-    assert rec["stale"] is True
-    # the committed campaign record (heat3d_256_f32_fused4, ~107 Gcells/s)
-    # is newer than the committed round-2 cache (85.6)
-    assert rec["value"] > 100_000
-    assert "results_r03.json" in rec["note"]
-    # corrupt caches must degrade, not raise (watchdog-thread safety)
-    bad = tmp_path / "bad.json"
-    bad.write_text('{"measured_at": "yesterday"}')
+def test_stale_fallback_replays_only_local_measurements(bench, tmp_path):
+    """Round-3 advisor (medium): a fresh checkout with a wedged backend
+    must NOT replay VCS data as a value.  Only a cache record written by a
+    real local bench run (``local_run: true``) is replayed; otherwise the
+    record reports 0.0 and points at the campaign table in the note."""
     old = bench._CACHE
-    bench._CACHE = str(bad)
     try:
+        # no cache at all -> unmeasured, value 0.0, campaign cited in note
+        bench._CACHE = str(tmp_path / "absent.json")
+        rec = bench._stale_fallback_record()
+        assert rec["stale"] is True and rec["value"] == 0.0
+        assert "results_r0" in rec["note"]
+        # a cache WITHOUT the local_run marker (e.g. committed seed data)
+        # is refused too
+        unmarked = tmp_path / "unmarked.json"
+        unmarked.write_text(json.dumps(
+            {"metric": "m", "value": 99999.0, "backend": "tpu",
+             "measured_at": 1785358700.0}))
+        bench._CACHE = str(unmarked)
+        rec = bench._stale_fallback_record()
+        assert rec["value"] == 0.0
+        # a genuine local record replays, stale-marked
+        local = tmp_path / "local.json"
+        local.write_text(json.dumps(
+            {"metric": "m", "value": 85621.8, "vs_baseline": 1.71,
+             "backend": "tpu", "measured_at": 1785358700.0,
+             "local_run": True}))
+        bench._CACHE = str(local)
+        rec = bench._stale_fallback_record()
+        assert rec["stale"] is True and rec["value"] == 85621.8
+        assert rec["metric"].endswith("_cached")
+        # corrupt caches must degrade, not raise (watchdog-thread safety)
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"measured_at": "yesterday", "local_run": true}')
+        bench._CACHE = str(bad)
         rec2 = bench._stale_fallback_record()
         assert rec2["stale"] is True
     finally:

@@ -1,0 +1,103 @@
+"""Per-device HBM budget guard (utils/budget.py).
+
+The reference mallocs the FULL grid on every rank with no error checking
+(kernel.cu:184-191); this framework refuses an over-HBM config up front
+with the arithmetic in the error.  These tests pin the BASELINE config-5
+budget table documented in docs/STATE.md.
+"""
+
+import pytest
+
+from mpi_cuda_process_tpu import make_stencil
+from mpi_cuda_process_tpu.utils import budget
+
+GiB = 2**30
+V5E_HBM = 16 * GiB
+
+
+def _total(name, grid, mesh=(), fuse=0, ensemble=0, **kw):
+    st = make_stencil(name, **kw)
+    total, parts = budget.estimate_run_bytes(
+        st, grid, mesh=mesh, fuse=fuse, ensemble=ensemble)
+    assert total == sum(b for _, b in parts)
+    return total
+
+
+def test_config5_f32_refused_with_arithmetic():
+    """4096^3 wave f32 on 64 chips does NOT fit: 2x4 GiB state + exchange
+    transients ~27 GiB/device. The guard must say so, with numbers."""
+    st = make_stencil("wave3d")
+    with pytest.raises(ValueError) as e:
+        budget.check_budget(st, (4096,) * 3, mesh=(8, 8, 1), fuse=4,
+                            hbm_bytes=V5E_HBM)
+    msg = str(e.value)
+    assert "GiB per device" in msg and "state: 2 field(s)" in msg
+    assert "bfloat16" in msg  # the actionable lever is named
+
+
+def test_config5_bf16_fits():
+    """bf16 halves everything: ~13.6 GiB/device at k=8 — the designed
+    config-5 execution (SURVEY.md §7.3.3)."""
+    st = make_stencil("wave3d", dtype="bfloat16")
+    total, _ = budget.check_budget(st, (4096,) * 3, mesh=(8, 8, 1), fuse=8,
+                                   hbm_bytes=V5E_HBM)
+    assert 10 * GiB < total < V5E_HBM
+
+
+def test_1024_padfree_fits_padded_does_not_appear():
+    """1024^3 f32 fused: prefer_padfree kicks in, so no pad transient is
+    counted and the config fits (~8.8 GiB) — the round-4 1024^3 design."""
+    st = make_stencil("heat3d")
+    total, parts = budget.estimate_run_bytes(st, (1024,) * 3, fuse=4)
+    assert total < 9.5 * GiB
+    assert any("pad-free" in label for label, _ in parts)
+
+
+def test_1024_jnp_estimate_reflects_pad_transient():
+    t_jnp = _total("heat3d", (1024,) * 3)
+    t_fused = _total("heat3d", (1024,) * 3, fuse=4)
+    assert t_jnp > t_fused  # the pad copy is the difference
+
+
+def test_ensemble_scales_estimate():
+    assert _total("heat3d", (256,) * 3, ensemble=8) > \
+        7 * _total("heat3d", (256,) * 3)
+
+
+def test_mesh_shrinks_local_block():
+    assert _total("heat3d", (512,) * 3, mesh=(2, 2, 2)) < \
+        _total("heat3d", (512,) * 3) / 4
+
+
+def test_small_config_passes_guard():
+    st = make_stencil("heat2d")
+    total, _ = budget.check_budget(st, (512, 512), hbm_bytes=V5E_HBM)
+    assert total < GiB
+
+
+def test_cli_flag_parses_and_cpu_backend_skips():
+    from mpi_cuda_process_tpu.cli import _check_mem_budget, config_from_args
+
+    cfg = config_from_args(
+        ["--stencil", "wave3d", "--grid", "4096,4096,4096",
+         "--mesh", "8,8,1", "--fuse", "4", "--mem-check", "error"])
+    assert cfg.mem_check == "error"
+    # CPU backend: the guard is a no-op (virtual-device test meshes would
+    # otherwise trip on host-RAM-sized grids)
+    _check_mem_budget(cfg)
+
+
+def test_raw_path_has_no_transient():
+    """compute="raw" (whole-step kernels: the state is its own halo) must
+    not be charged a pad transient — a fitting raw run was refused before
+    this was threaded through (round-4 review finding)."""
+    st = make_stencil("heat3d27")
+    grid = (1152, 1152, 1152)
+    t_raw, parts = budget.estimate_run_bytes(st, grid, compute="raw")
+    t_jnp, _ = budget.estimate_run_bytes(st, grid)
+    assert t_raw < t_jnp
+    assert any("no pad transient" in label for label, _ in parts)
+    # ~5.7 GiB state + 5.7 out + 10% — fits 16 GiB where jnp would not
+    budget.check_budget(st, grid, compute="raw", hbm_bytes=16 * GiB)
+    with pytest.raises(ValueError):
+        budget.check_budget(st, grid, hbm_bytes=16 * GiB)
