@@ -1,0 +1,204 @@
+"""HBM streaming-rate probes: can anything beat Mosaic's auto-pipeline?
+
+Round-3 finding (docs/STATE.md §4): a pure copy kernel (out = 2*in)
+through ``pallas_call``'s automatic pipeline tops out at ~330 GB/s on this
+v5e, independent of block shape, grid arity, and dimension_semantics —
+while the XLA-fused jnp path streams 640-710 GB/s on the same chip.  That
+pipeline rate bounds every single-step Pallas kernel (~40 Gcells/s) and
+sets the fused kernels' ceiling.  ``pl.Buffered(buffer_count > 2)`` is not
+supported by this toolchain, so the remaining lever is a MANUAL pipeline:
+whole-array ANY-memory-space refs + ``pltpu.make_async_copy`` chunk DMAs
+with N rotating VMEM slots (the double-buffering pattern in the public
+Pallas TPU docs).
+
+Probes (each its own label; run on a HEALTHY, otherwise-idle tunnel):
+  auto_copy      pallas_call auto-pipeline baseline (reproduces the 330)
+  manual2_copy   manual pipeline, 2 VMEM slots
+  manual4_copy   manual pipeline, 4 slots (deeper DMA overlap)
+  jnp_copy       XLA's own fused stream (the 640-710 reference point)
+
+Usage: python benchmarks/pipeline_probe.py [--probe NAME ...] [--out F]
+Writes/merges JSON records (GB/s) into benchmarks/pipeline_probe.json.
+Interpret-mode smoke: tests/test_pipeline_probe.py runs every probe tiny
+on CPU, so the harness itself is CI-covered before it ever costs tunnel
+time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mpi_cuda_process_tpu.ops.pallas.kernels import (
+    _VMEM_LIMIT_BYTES,
+    _interpret_default,
+)
+
+
+def _auto_copy(shape, dtype, bz, interpret):
+    """pallas_call auto-pipeline: the measured-330 baseline."""
+    Z, Y, X = shape
+
+    def kernel(i_ref, o_ref):
+        o_ref[...] = i_ref[...] * 2.0
+
+    return pl.pallas_call(
+        kernel,
+        grid=(Z // bz,),
+        in_specs=[pl.BlockSpec((bz, Y, X), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((bz, Y, X), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(shape, dtype),
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT_BYTES),
+    )
+
+
+def _manual_copy_kernel(nslots, bz, nchunks, i_hbm, o_hbm):
+    """N-slot rotating DMA pipeline over z-chunks of a whole-array ref.
+
+    Loads overlap compute/stores: slot s starts its load up to nslots-1
+    chunks ahead of consumption.  The store is a plain HBM write from
+    VMEM (Mosaic lowers it as a DMA); a deeper variant could rotate
+    output slots too, but the load path is where the round-3 measured
+    pipeline stalled.
+    """
+
+    def body(scratch, sems):
+        def dma(slot, chunk):
+            return pltpu.make_async_copy(
+                i_hbm.at[pl.ds(chunk * bz, bz)],
+                scratch.at[slot],
+                sems.at[slot],
+            )
+
+        for s in range(nslots - 1):  # warm-up: fill the pipeline
+            dma(s, s).start()
+
+        def loop(chunk, _):
+            slot = jax.lax.rem(chunk, nslots)
+            nxt = chunk + nslots - 1
+
+            @pl.when(nxt < nchunks)
+            def _():
+                dma(jax.lax.rem(nxt, nslots), nxt).start()
+
+            dma(slot, chunk).wait()
+            o_hbm[pl.ds(chunk * bz, bz)] = scratch[slot] * 2.0
+            return ()
+
+        jax.lax.fori_loop(0, nchunks, loop, ())
+
+    pl.run_scoped(
+        body,
+        scratch=pltpu.VMEM(
+            (nslots, bz) + tuple(i_hbm.shape[1:]), i_hbm.dtype),
+        sems=pltpu.SemaphoreType.DMA((nslots,)),
+    )
+
+
+def _manual_copy(shape, dtype, bz, nslots, interpret):
+    Z, Y, X = shape
+    nchunks = Z // bz
+
+    def kernel(i_hbm, o_hbm):
+        _manual_copy_kernel(nslots, bz, nchunks, i_hbm, o_hbm)
+
+    return pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct(shape, dtype),
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT_BYTES),
+    )
+
+
+def build_probe(name, shape, dtype=jnp.float32, bz=16, interpret=None):
+    """Return a jittable ``x -> 2*x`` implementing the named strategy."""
+    if interpret is None:
+        interpret = _interpret_default()
+    if name == "jnp_copy":
+        return lambda x: x * 2.0
+    if name == "auto_copy":
+        return _auto_copy(shape, dtype, bz, interpret)
+    if name.startswith("manual"):
+        nslots = int(name[len("manual"):name.index("_")])
+        return _manual_copy(shape, dtype, bz, nslots, interpret)
+    raise ValueError(f"unknown probe {name!r}")
+
+
+PROBES = ("jnp_copy", "auto_copy", "manual2_copy", "manual4_copy")
+
+
+def measure_probe(name, shape=(512, 512, 512), bz=16, steps=30, reps=3):
+    """GB/s for one probe via the N-vs-4N scan difference (bench.py's
+    dispatch-cancelling method)."""
+    fn = build_probe(name, shape, bz=bz, interpret=False)
+
+    def scan_n(n):
+        def run(x):
+            return jax.lax.fori_loop(0, n, lambda _, v: fn(v), x)
+
+        return jax.jit(run)
+
+    x = jnp.ones(shape, jnp.float32)
+    run_a, run_b = scan_n(steps), scan_n(4 * steps)
+    float(jnp.sum(run_a(x)))  # compile+warm
+    float(jnp.sum(run_b(x)))
+
+    def best(run):
+        b = math.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(jnp.sum(run(x)))
+            b = min(b, time.perf_counter() - t0)
+        return b
+
+    t = (best(run_b) - best(run_a)) / (3 * steps)
+    bytes_per_step = 2 * math.prod(shape) * 4  # 1R + 1W f32
+    return {"gb_per_s": round(bytes_per_step / t / 1e9, 1),
+            "ms_per_pass": round(t * 1e3, 3), "bz": bz,
+            "shape": list(shape)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe", nargs="*", default=list(PROBES))
+    ap.add_argument("--bz", type=int, default=16)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "pipeline_probe.json"))
+    args = ap.parse_args()
+    results = {}
+    if os.path.exists(args.out):
+        with open(args.out) as fh:
+            results = json.load(fh)
+    for name in args.probe:
+        try:
+            rec = measure_probe(name, bz=args.bz)
+        except Exception as e:  # noqa: BLE001 — record & continue
+            rec = {"error": f"{type(e).__name__}: {str(e)[:600]}"}
+        rec["measured_at"] = time.time()
+        results[f"{name}_bz{args.bz}"] = rec
+        print(f"[probe] {name}: {rec}", file=sys.stderr)
+        with open(args.out + ".tmp", "w") as fh:
+            json.dump(results, fh, indent=1, sort_keys=True)
+        os.replace(args.out + ".tmp", args.out)
+    print(json.dumps(results, indent=1, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()
