@@ -170,6 +170,12 @@ _AUTO_FUSE_K = {"heat3d": 4, "heat3d27": 4, "wave3d": 4}
 # heat3d_*_bf16_fused8 / *_padfree8 in benchmarks/measure.py).  EMPTY
 # until then: bf16 runs stay on jnp unless --fuse 8 is explicit.
 _AUTO_FUSE_K_BF16: dict = {}
+# 2D whole-grid-in-VMEM temporal blocking (ops/pallas/fullgrid.py): k
+# generations per HBM residency, exact (no windows).  EMPTY until the
+# campaign's *_full16/32 labels land a measured win per family (life
+# 2048^2 jnp = 53.8 Gcells/s is the number to beat); flipping a family is
+# then a one-line data change here.
+_AUTO_FULL_K: dict = {}
 
 
 def _uses_mesh(cfg: RunConfig) -> bool:
@@ -199,14 +205,19 @@ def maybe_auto_fuse(cfg: RunConfig) -> RunConfig:
         return cfg
     if jax.default_backend() != "tpu":
         return cfg
-    params = dict(cfg.params)
-    dtype = cfg.dtype or params.get("dtype")
-    if dtype is None or jnp.dtype(dtype) == jnp.float32:
-        k = _AUTO_FUSE_K.get(cfg.stencil)
-    elif jnp.dtype(dtype) == jnp.bfloat16:
-        k = _AUTO_FUSE_K_BF16.get(cfg.stencil)
+    if len(cfg.grid) == 2:
+        # 2D: whole-grid-in-VMEM temporal blocking (dtype-agnostic — the
+        # kernel is exact, incl. the bit-exact int32 Life path)
+        k = _AUTO_FULL_K.get(cfg.stencil)
     else:
-        k = None  # int/other dtypes: no fused 3D families
+        params = dict(cfg.params)
+        dtype = cfg.dtype or params.get("dtype")
+        if dtype is None or jnp.dtype(dtype) == jnp.float32:
+            k = _AUTO_FUSE_K.get(cfg.stencil)
+        elif jnp.dtype(dtype) == jnp.bfloat16:
+            k = _AUTO_FUSE_K_BF16.get(cfg.stencil)
+        else:
+            k = None  # int/other dtypes: no fused 3D families
     if k is None:
         return cfg
     if (cfg.periodic or cfg.tol > 0 or cfg.debug_checks or cfg.ensemble
@@ -216,14 +227,22 @@ def maybe_auto_fuse(cfg: RunConfig) -> RunConfig:
                 cfg.check_finite, cfg.dump_every]
     if any(v % k for v in cadences if v):
         return cfg
-    from .ops.pallas.fused import make_fused_step, prefer_padfree
     st = _make_cfg_stencil(cfg)
-    # probe the same variant build() will construct (pad-free above the
-    # HBM threshold — the 1024^3 path)
-    if make_fused_step(st, cfg.grid, k,
-                       padfree=prefer_padfree(st, cfg.grid)) is None:
-        return cfg  # untileable shape
-    log.info("auto: temporal blocking k=%d (fused Pallas kernel)", k)
+    if len(cfg.grid) == 2:
+        from .ops.pallas.fullgrid import make_fullgrid_step
+
+        if make_fullgrid_step(st, cfg.grid, k) is None:
+            return cfg  # unaligned extents / over the VMEM budget
+        log.info("auto: temporal blocking k=%d (whole-grid VMEM kernel)", k)
+    else:
+        from .ops.pallas.fused import make_fused_step, prefer_padfree
+
+        # probe the same variant build() will construct (pad-free above
+        # the HBM threshold — the 1024^3 path)
+        if make_fused_step(st, cfg.grid, k,
+                           padfree=prefer_padfree(st, cfg.grid)) is None:
+            return cfg  # untileable shape
+        log.info("auto: temporal blocking k=%d (fused Pallas kernel)", k)
     return dataclasses.replace(cfg, fuse=k)
 
 

@@ -364,3 +364,25 @@ def test_pallas_failure_heuristic():
         assert cli._looks_like_pallas_failure(e)
     else:  # pragma: no cover
         raise AssertionError("expected an exception from fused internals")
+
+
+def test_auto_full_2d_policy_table(monkeypatch):
+    """2D families upgrade via _AUTO_FULL_K (whole-grid VMEM kernel) once
+    a family is flipped in; the table ships empty until measured."""
+    from mpi_cuda_process_tpu import cli
+    from mpi_cuda_process_tpu.ops.pallas import fullgrid
+
+    monkeypatch.setattr(cli.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(fullgrid, "_interpret_default", lambda: True)
+    base = dict(grid=(64, 128), iters=16)
+    # empty table: no upgrade
+    assert cli.maybe_auto_fuse(RunConfig(stencil="life", **base)).fuse == 0
+    # flipped family upgrades (builder still validates alignment/VMEM)
+    monkeypatch.setitem(cli._AUTO_FULL_K, "life", 8)
+    assert cli.maybe_auto_fuse(RunConfig(stencil="life", **base)).fuse == 8
+    # cadence misalignment still blocks
+    assert cli.maybe_auto_fuse(
+        RunConfig(stencil="life", grid=(64, 128), iters=12)).fuse == 0
+    # unaligned width declines at the builder
+    assert cli.maybe_auto_fuse(
+        RunConfig(stencil="life", grid=(64, 100), iters=16)).fuse == 0
