@@ -23,13 +23,17 @@ import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# (label, grid, k, tiles) — smallest/cheapest first so the first hang
-# gives the tightest bound.
+# (label, grid, k, tiles, padfree) — smallest/cheapest first so the first
+# hang gives the tightest bound.  k=8 now lowers as a fori_loop (constant
+# program size — the candidate fix for the round-3 unrolled-compile hang);
+# the padfree rungs cover the 9-block kernel's compile too.
 ATTEMPTS = [
-    ("256_k8_t16", (256, 256, 256), 8, (16, 16)),
-    ("256_k8_t32", (256, 256, 256), 8, (32, 32)),
-    ("256_k8_t64", (256, 256, 256), 8, (64, 64)),  # the known ~hang
-    ("512_k8_t32", (512, 512, 512), 8, (32, 32)),
+    ("256_k8_t16", (256, 256, 256), 8, (16, 16), False),
+    ("256_k8_t32", (256, 256, 256), 8, (32, 32), False),
+    ("256_k8_t64", (256, 256, 256), 8, (64, 64), False),  # the known ~hang
+    ("256_k8_t32_padfree", (256, 256, 256), 8, (32, 32), True),
+    ("512_k8_t32", (512, 512, 512), 8, (32, 32), False),
+    ("512_k8_t32_padfree", (512, 512, 512), 8, (32, 32), True),
 ]
 
 _CHILD = """\
@@ -40,9 +44,9 @@ from mpi_cuda_process_tpu import init_state, make_stencil
 from mpi_cuda_process_tpu.driver import make_runner
 from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
 
-grid, k, tiles = {grid!r}, {k!r}, {tiles!r}
+grid, k, tiles, padfree = {grid!r}, {k!r}, {tiles!r}, {padfree!r}
 st = make_stencil("heat3d", dtype=jnp.bfloat16)
-step = make_fused_step(st, grid, k, tiles=tiles)
+step = make_fused_step(st, grid, k, tiles=tiles, padfree=padfree)
 assert step is not None, "untileable"
 f = init_state(st, grid, kind="pulse")
 t0 = time.time()
@@ -67,8 +71,9 @@ def main():
     args = ap.parse_args()
 
     results = {}
-    for label, grid, k, tiles in ATTEMPTS:
-        code = _CHILD.format(repo=_REPO, grid=grid, k=k, tiles=tiles)
+    for label, grid, k, tiles, padfree in ATTEMPTS:
+        code = _CHILD.format(repo=_REPO, grid=grid, k=k, tiles=tiles,
+                             padfree=padfree)
         t0 = time.time()
         try:
             p = subprocess.run([sys.executable, "-c", code], cwd=_REPO,
