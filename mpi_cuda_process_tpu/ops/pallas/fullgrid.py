@@ -115,20 +115,25 @@ def _halo_per_micro_2d(stencil: Stencil) -> int:
     return micro_halo * max(1, len(stencil.phases or ()))
 
 
-def _build_call(stencil, block_shape, m, k, interpret, masked,
+def _build_call(stencil, block_shape, m, k, interpret, sharded_global=None,
                 periodic=False):
     """Shared scaffolding for both whole-grid kernels (cf. fused.py's
-    single builder with a ``masked`` flag).
+    single builder with a ``sharded_global`` flag).
 
-    ``block_shape`` is the in-VMEM block: the whole grid (``masked=False``,
-    ``m == 0``, frame derived from iota) or the halo-padded local block
-    (``masked=True``, frame mask supplied as an extra input because the
-    shard's global origin is traced).  Output is the ``m``-inset core.
-    ``periodic`` (unmasked only): no guard frame exists — the neighbor
-    rolls' wrap-around IS the periodic boundary, exactly (rolls wrap at
-    the domain extents because the whole grid is the block), so the frame
-    mask is identically False.  Returns ``(call, nfields)`` or None.
+    ``block_shape`` is the in-VMEM block: the whole grid
+    (``sharded_global=None``, ``m == 0``, frame derived from iota) or the
+    halo-padded local block (``sharded_global=(H, W)`` — the GLOBAL
+    extents; the shard's y-origin arrives as an SMEM (1,) int32 scalar
+    input, first, and the frame is derived in-kernel: a BlockSpec
+    index_map cannot see the traced axis_index but the kernel body can
+    read SMEM, so no mask ARRAY is streamed — same technique as
+    fused._fused_kernel).  Output is the ``m``-inset core.
+    ``periodic``: no guard frame exists — unsharded, the neighbor rolls'
+    wrap-around IS the periodic boundary; sharded, the exchanged slabs are
+    real wrapped data — so the frame mask is identically False and no
+    origin input is needed.  Returns ``(call, nfields)`` or None.
     """
+    sharded = sharded_global is not None
     if not fullgrid_supported(stencil) or k < 1:
         return None
     if interpret is None:
@@ -142,27 +147,34 @@ def _build_call(stencil, block_shape, m, k, interpret, masked,
     if W % 128 or m % sublane or Ly < m or Ly % sublane:
         return None
     micro_factory, halo, nfields = _MICRO2D[stencil.name]
-    if m and not masked and not periodic:
-        return None  # an inset store without a mask needs periodic wrap
+    if m and not sharded and not periodic:
+        return None  # an inset store without global bounds needs wrap
     if m:
         # One micro-step advances information by halo cells PER PHASE (the
         # red-black black sweep reads this micro-step's fresh red values):
         # shared accounting with the 3D windowed kernels.
         if m != k * _halo_per_micro_2d(stencil):
             return None
-    n_in = nfields + (1 if masked else 0)
-    if _live_factor(stencil.name) * n_in * Hp * W * itemsize \
+    if _live_factor(stencil.name) * nfields * Hp * W * itemsize \
             > _VMEM_LIMIT_BYTES:
         return None
     micro = micro_factory(stencil, interpret)
+    with_origin = sharded and not periodic
 
     def kernel(*refs):
+        if with_origin:
+            y_off, refs = refs[0][0], refs[1:]
         fields = tuple(r[...] for r in refs[:nfields])
         like = fields[0]
-        if masked:
-            frame = refs[nfields][...] != 0
-        elif periodic:
+        if periodic:
             frame = jnp.zeros(like.shape, jnp.bool_)
+        elif sharded:
+            H, _W = sharded_global
+            gy = (jax.lax.broadcasted_iota(jnp.int32, like.shape, 0)
+                  + y_off - m)
+            gx = jax.lax.broadcasted_iota(jnp.int32, like.shape, 1)
+            frame = ((gy < halo) | (gy >= H - halo)
+                     | (gx < halo) | (gx >= W - halo))
         else:
             yi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 0)
             xi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 1)
@@ -183,15 +195,17 @@ def _build_call(stencil, block_shape, m, k, interpret, masked,
             return micro(fs, frame, *extra)
 
         fields = jax.lax.fori_loop(0, k, body, fields)
-        for o, f in zip(refs[n_in:], fields):
+        for o, f in zip(refs[nfields:], fields):
             o[...] = f[m:m + Ly, :] if m else f
 
     in_spec = pl.BlockSpec((Hp, W), lambda: (0, 0))
     out_spec = pl.BlockSpec((Ly, W), lambda: (0, 0))
+    extra_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] \
+        if with_origin else []
     call = pl.pallas_call(
         kernel,
         grid=(),
-        in_specs=[in_spec] * n_in,
+        in_specs=extra_specs + [in_spec] * nfields,
         out_specs=[out_spec] * nfields,
         out_shape=[jax.ShapeDtypeStruct((Ly, W), stencil.dtype)
                    for _ in range(nfields)],
@@ -222,7 +236,7 @@ def make_fullgrid_step(
     # (No parity/odd-extent gate needed for periodic red-black models:
     # the alignment gates in _build_call already force even extents.)
     built = _build_call(stencil, tuple(int(s) for s in global_shape),
-                        0, k, interpret, masked=False, periodic=periodic)
+                        0, k, interpret, periodic=periodic)
     if built is None:
         return None
     call, _ = built
@@ -240,29 +254,32 @@ def build_fullgrid_masked_call(
     k: int,
     interpret: Optional[bool] = None,
     periodic: bool = False,
+    global_shape=None,
 ):
     """Whole-LOCAL-block variant for the sharded 2D path (shard_map).
 
     The caller (parallel.stepper.make_sharded_fullgrid_step) exchanges
     width-``m`` y-halos (``m = k * halo * phases``), so the input blocks
-    are ``(local_y + 2m, X)`` and the frame mask (nonzero = pinned: global
-    guard frame + out-of-domain pad cells) arrives as an input array —
-    each shard's global origin is a traced axis_index, which the kernel
-    prelude cannot see.  Output is the core ``(local_y, X)``; rows within
-    ``m`` of the padded edge are temporal-validity casualties exactly as
-    in the windowed 3D kernels.  Parity-sensitive models derive color
-    from block-local coordinates, which matches global parity when the
-    caller enforces even local extents and even ``m`` (ops/sor.py's
-    documented sharding caveat).
+    are ``(local_y + 2m, X)``.  In guard-frame mode the call takes the
+    shard's global y-origin as an SMEM (1,) int32 input FIRST and derives
+    the frame in-kernel from it + ``global_shape`` — no mask array is
+    streamed (same technique as the 3D path; a BlockSpec index_map cannot
+    see the traced axis_index, the kernel body can).  Output is the core
+    ``(local_y, X)``; rows within ``m`` of the padded edge are
+    temporal-validity casualties exactly as in the windowed 3D kernels.
+    Parity-sensitive models derive color from block-local coordinates,
+    which matches global parity when the caller enforces even local
+    extents and even ``m`` (ops/sor.py's documented sharding caveat).
 
     Returns ``(call, nfields)`` or None (unsupported family, unaligned
     shape, or VMEM budget exceeded).
     """
     if m < 1:
         return None
-    # Periodic drops the mask input entirely (frame is identically False
-    # and the exchanged slabs are real wrapped data) — no constant-zero
-    # array streamed through VMEM, and the budget gate counts one fewer
-    # input.
-    return _build_call(stencil, padded_shape, m, k, interpret,
-                       masked=not periodic, periodic=periodic)
+    if not periodic and global_shape is None:
+        return None
+    return _build_call(
+        stencil, padded_shape, m, k, interpret,
+        sharded_global=None if periodic
+        else tuple(int(s) for s in global_shape),
+        periodic=periodic)

@@ -276,48 +276,44 @@ def _run_micros(micro, fields, frame, extra, k):
 
 
 def _fused_kernel(micro, nfields, k, margin, halo, bz, by, shape, periodic,
-                  parity, interpret, *refs):
+                  parity, sharded, interpret, *refs):
     """k micro-steps on constant-shape VMEM windows; multi-field generic.
 
-    ``refs`` is 4 window blocks per field (core, y-tail, z-tail, corner —
-    overlapping BlockSpecs must start block-aligned, hence the assembly),
-    then — when ``shape`` is None — 4 blocks of a precomputed frame-mask
-    array, followed by ``nfields`` output blocks.  ``margin = k * halo *
-    phases`` is the temporal-validity margin consumed by the k micro-steps
-    (``_halo_per_micro``); ``halo`` is the stencil's guard-frame width.
+    ``refs`` is — when ``sharded`` — an SMEM (2,) int32 scalar ref holding
+    this shard's global (z, y) origin first, then 4 window blocks per
+    field (core, y-tail, z-tail, corner — overlapping BlockSpecs must
+    start block-aligned, hence the assembly), then ``nfields`` output
+    blocks.  ``margin = k * halo * phases`` is the temporal-validity
+    margin consumed by the k micro-steps (``_halo_per_micro``); ``halo``
+    is the stencil's guard-frame width.
 
-    ``shape`` carries the global (Z, Y, X) for the single-device case,
-    where the frame mask is derived from ``program_id``; the sharded caller
-    passes ``shape=None`` and supplies the mask as a windowed input instead
-    (each shard's global origin is a traced axis_index, which a BlockSpec
-    index_map cannot see).
+    ``shape`` is the GLOBAL (Z, Y, X): with it the frame mask is derived
+    in-kernel from program ids (+ the origin scalars when sharded) —
+    a BlockSpec index_map cannot see the traced axis_index, but the
+    kernel body can read it from SMEM, which is why no mask ARRAY is ever
+    streamed (round 3 streamed a whole padded mask per step).
 
-    ``periodic`` (with ``shape``): no guard frame — the caller wrap-pads
-    z/y, and the in-window lane rolls wrap at X = the full domain width
-    (x is never sharded or padded), which IS the periodic x boundary.
+    ``periodic`` (unsharded): no guard frame — the caller wrap-pads z/y,
+    and the in-window lane rolls wrap at X = the full domain width (x is
+    never sharded or padded), which IS the periodic x boundary.  The
+    sharded periodic caller uses ``sharded=False`` with the LOCAL shape
+    (wrap halos arrive via the exchange; parity stays globally consistent
+    because shard origins and extents are even by the alignment gates).
     """
+    if sharded:
+        origins, refs = refs[0], refs[1:]
+        z_off, y_off = origins[0], origins[1]
+    else:
+        z_off = y_off = 0
     fields = tuple(
         _assemble_window(*refs[4 * f:4 * f + 4]) for f in range(nfields))
     like = fields[0]
-    extra = ()
-    if shape is None:
-        frame = _assemble_window(*refs[4 * nfields:4 * nfields + 4]) != 0
-        outs = refs[4 * nfields + 4:]
-        if parity:
-            # Block-local parity == global parity: tile extents, the
-            # margin, and every shard origin are even by the alignment
-            # gates (same argument as fullgrid.py's sharded prelude).
-            zi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 0)
-            yi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 1)
-            xi = jax.lax.broadcasted_iota(jnp.int32, like.shape, 2)
-            extra = ((zi + yi + xi) % 2,)
-    else:
-        outs = refs[4 * nfields:]
-        # Window origin in global coords (input pre-padded by margin
-        # in z/y).
-        frame, extra = _window_frame(
-            like.shape, pl.program_id(0) * bz - margin,
-            pl.program_id(1) * by - margin, shape, halo, periodic, parity)
+    outs = refs[4 * nfields:]
+    # Window origin in global coords (input pre-padded by margin in z/y).
+    frame, extra = _window_frame(
+        like.shape, z_off + pl.program_id(0) * bz - margin,
+        y_off + pl.program_id(1) * by - margin, shape, halo, periodic,
+        parity)
     # k<=4 unrolls (measured-fast); deeper k runs as a fori_loop — the
     # unrolled bf16 k=8 hung the Mosaic compile (results_r03.json
     # heat3d_256_bf16_fused8), and a loop body keeps program size constant.
@@ -512,7 +508,7 @@ def build_fused_call(
     k: int,
     tiles: Optional[Tuple[int, int]] = None,
     interpret: Optional[bool] = None,
-    masked: bool = False,
+    sharded_global: Optional[Tuple[int, int, int]] = None,
     periodic: bool = False,
     padfree: bool = False,
 ):
@@ -520,20 +516,27 @@ def build_fused_call(
 
     Returns ``(call, margin, nfields)`` or None if untileable.  The call
     takes, per field, 4 views of the z/y-padded block (pass the same padded
-    array 4 times) — plus, when ``masked``, 4 views of a same-shape
-    frame-mask array (nonzero = pinned) — and returns ``nfields`` arrays of
-    ``core_shape``.  ``masked=False`` derives the mask from program ids and
-    the global shape (single-device use); ``masked=True`` is for callers
-    whose blocks sit at a traced global offset (shard_map).
+    array 4 times) and returns ``nfields`` arrays of ``core_shape``.
+
+    ``sharded_global``: the GLOBAL grid shape, for callers whose block
+    sits at a traced global offset (shard_map).  The call then takes an
+    int32 ``(2,)`` origins array FIRST (this shard's global z/y origin of
+    the unpadded block): the frame mask is derived in-kernel from the
+    origin scalars (read from SMEM) + program ids, so NO mask array is
+    streamed — round 3 streamed a whole padded mask per step, a full
+    extra input's worth of HBM traffic and memory.
 
     ``padfree=True`` builds the 9-block raw-grid kernel instead (see
     ``_fused_raw_kernel``): the call takes 9 views of the UNPADDED field
     (pass it 9 times) and no pad transient is needed.  Incompatible with
-    ``masked`` (the sharded caller pads its local block, which is small).
+    ``sharded_global`` (the sharded caller pads its local block: interior
+    shard faces need genuine neighbor values, which the clamp trick
+    cannot supply).
     """
+    sharded = sharded_global is not None
     if not fused_supported(stencil):
         return None
-    if padfree and masked:
+    if padfree and sharded:
         return None
     if interpret is None:
         interpret = _interpret_default()
@@ -548,8 +551,7 @@ def build_fused_call(
         return None
     itemsize = jnp.dtype(stencil.dtype).itemsize
     if tiles is None:
-        tiles = _pick_tiles(Z, Y, X, margin, itemsize,
-                            nfields + (1 if masked else 0),
+        tiles = _pick_tiles(Z, Y, X, margin, itemsize, nfields,
                             wm=2 * margin if padfree else None)
     if tiles is None:
         return None
@@ -558,12 +560,12 @@ def build_fused_call(
 
     grid = (Z // bz, Y // by)
     m = margin
+    extra_specs = []
     if padfree:
         per_field_specs = _raw_window_specs(Z, Y, X, bz, by, m, periodic)
         kernel = functools.partial(
             _fused_raw_kernel, micro, nfields, k, m, halo, bz, by,
             (Z, Y, X), periodic, stencil.parity_sensitive, interpret)
-        n_in_sets = nfields
     else:
         # Four aligned views of the z/y-padded input reassemble each
         # program's overlapping (bz+2m, by+2m, X) window; alignment needs
@@ -581,15 +583,18 @@ def build_fused_call(
         ]
         kernel = functools.partial(
             _fused_kernel, micro, nfields, k, m, halo, bz, by,
-            None if masked else (Z, Y, X), periodic,
-            stencil.parity_sensitive, interpret)
-        n_in_sets = nfields + (1 if masked else 0)
+            sharded_global if sharded else (Z, Y, X), periodic,
+            stencil.parity_sensitive, sharded, interpret)
+        if sharded:
+            # whole (2,) origins array into scalar memory, same for every
+            # grid step
+            extra_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
     out_spec = pl.BlockSpec((bz, by, X), lambda i, j: (i, j, 0))
 
     call = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=per_field_specs * n_in_sets,
+        in_specs=extra_specs + per_field_specs * nfields,
         out_specs=[out_spec] * nfields,
         out_shape=[jax.ShapeDtypeStruct((Z, Y, X), stencil.dtype)
                    for _ in range(nfields)],

@@ -239,11 +239,13 @@ def make_sharded_fused_step(
          unsharded axes;
       2. the fused k-micro-step Pallas kernel on the padded local block.
 
-    The global guard frame is pinned every micro-step via a precomputed
-    mask array (nonzero = frame/out-of-domain cell) handed to the kernel as
-    a windowed input: each shard's global origin is a traced axis_index,
-    so the kernel cannot derive the mask from program ids the way the
-    single-device path does.
+    The global guard frame is pinned every micro-step from a frame mask
+    derived IN-KERNEL: the shard's global origin (a traced axis_index,
+    invisible to BlockSpec index_maps) is handed to the kernel as an SMEM
+    (2,) scalar input, and the kernel combines it with program ids + the
+    static global shape.  Round 3 streamed a whole padded mask ARRAY per
+    step instead — a full extra input's worth of HBM traffic and, at the
+    4096^3 scale, ~4 GiB of per-device live bytes, both now gone.
 
     Constraints (returns None when unmet, callers fall back):
       * 3D stencil with a fused kernel (fused_supported);
@@ -270,12 +272,15 @@ def make_sharded_fused_step(
     if any(g % c for g, c in zip(global_shape, counts)):
         return None
     local_shape = tuple(g // c for g, c in zip(global_shape, counts))
-    # Periodic uses the UNMASKED kernel (frame identically False): no
-    # constant-zero mask array is streamed, and _pick_tiles budgets one
-    # fewer input.  Only the guard-frame case needs the mask input (the
-    # shard's global origin is traced).
-    built = build_fused_call(stencil, local_shape, k, interpret=interpret,
-                             masked=not periodic, periodic=periodic)
+    # Periodic keeps frame identically False (no origins needed): wrap
+    # halos arrive via the exchange, and parity stays globally consistent
+    # because shard origins/extents are even (alignment gates).  The
+    # guard-frame case passes the global shape so the kernel derives the
+    # frame from the origin scalars.
+    gshape = tuple(int(g) for g in global_shape)
+    built = build_fused_call(
+        stencil, local_shape, k, interpret=interpret,
+        sharded_global=None if periodic else gshape, periodic=periodic)
     if built is None:
         return None
     call, m, nfields = built
@@ -294,25 +299,15 @@ def make_sharded_fused_step(
                     f, d, axis_names[d], counts[d], m, bc,
                     periodic=periodic)
             padded.append(f)
-        # frame mask over the padded block, from global coordinates
-        # (nonzero = pinned: the guard frame AND out-of-domain pad cells)
-        offs = tuple(
-            lax.axis_index(n) * ls if n else 0
-            for n, ls in zip(axis_names, local_shape)
-        )
         args = [p for p in padded for _ in range(4)]
         if not periodic:
-            h = stencil.halo
-            pshape = padded[0].shape
-            mask = None
-            for d in range(3):
-                pad_d = m if d < 2 else 0
-                coord = (lax.broadcasted_iota(jnp.int32, pshape, d)
-                         + offs[d] - pad_d)
-                g = global_shape[d]
-                md = (coord < h) | (coord >= g - h)
-                mask = md if mask is None else mask | md
-            args += [mask.astype(stencil.dtype)] * 4
+            # this shard's global (z, y) origin of the UNPADDED block —
+            # the kernel derives the frame mask from these scalars
+            origins = jnp.array([
+                lax.axis_index(axis_names[d]) * local_shape[d]
+                if axis_names[d] else 0
+                for d in (0, 1)], dtype=jnp.int32)
+            args = [origins] + args
         return tuple(call(*args))
 
     return shard_map(
@@ -368,14 +363,13 @@ def make_sharded_fullgrid_step(
     m = k * _halo_per_micro_2d(stencil)
     built = build_fullgrid_masked_call(
         stencil, (local_shape[0] + 2 * m, local_shape[1]), m, k,
-        interpret=interpret, periodic=periodic)
+        interpret=interpret, periodic=periodic,
+        global_shape=global_shape)
     if built is None:
         return None
     call, nfields = built
     assert nfields == stencil.num_fields
     spec = grid_partition_spec(ndim, mesh)
-    H, W = (int(s) for s in global_shape)
-    h = stencil.halo
 
     def local_step(fields: Fields) -> Fields:
         from .halo import exchange_pad_axis
@@ -385,17 +379,16 @@ def make_sharded_fullgrid_step(
                               periodic=periodic)
             for f, bc in zip(fields, stencil.bc_value)
         ]
-        y0 = lax.axis_index(axis_names[0]) * local_shape[0] \
-            if axis_names[0] else 0
         if periodic:
             # wrapped slabs are real data; the x rolls wrap at the full
-            # domain width (x unsharded) — nothing is pinned, no mask input
+            # domain width (x unsharded) — nothing is pinned, no origin
             return tuple(call(*padded))
-        pshape = padded[0].shape
-        gy = lax.broadcasted_iota(jnp.int32, pshape, 0) + y0 - m
-        gx = lax.broadcasted_iota(jnp.int32, pshape, 1)
-        mask = ((gy < h) | (gy >= H - h) | (gx < h) | (gx >= W - h))
-        return tuple(call(*padded, mask.astype(stencil.dtype)))
+        # shard's global y-origin of the UNPADDED block, as an SMEM
+        # scalar — the kernel derives the frame mask from it
+        y0 = lax.axis_index(axis_names[0]) * local_shape[0] \
+            if axis_names[0] else 0
+        origin = jnp.array([y0], dtype=jnp.int32)
+        return tuple(call(origin, *padded))
 
     return shard_map(
         local_step,

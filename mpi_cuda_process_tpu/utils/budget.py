@@ -65,9 +65,10 @@ def estimate_run_bytes(
     """Peak per-device live bytes for a run, with a labeled breakdown.
 
     Mirrors ``cli.build``'s strategy selection coarsely: temporal blocking
-    (``fuse``) on its padded / pad-free / sharded-masked variants, the raw
-    whole-step kernels (no transient: the state is its own halo), and the
-    jnp pad -> update path.  Returns ``(total, [(label, bytes), ...])``.
+    (``fuse``) on its padded / pad-free / sharded (exchange-padded,
+    SMEM-origin frame) variants, the raw whole-step kernels (no
+    transient: the state is its own halo), and the jnp pad -> update
+    path.  Returns ``(total, [(label, bytes), ...])``.
     """
     itemsize = jnp.dtype(stencil.dtype).itemsize
     nfields = stencil.num_fields
@@ -94,12 +95,12 @@ def estimate_run_bytes(
         lz, ly, lx = local
         padded_b = batch * (lz + 2 * m) * (ly + 2 * m) * lx * itemsize
         if sharded:
-            # exchange-padded local block per field + (non-periodic) the
-            # frame-mask array, same padded shape (stepper.py local_step)
-            n_pad = nfields + (0 if periodic else 1)
+            # exchange-padded local block per field (stepper.py
+            # local_step); the frame comes from SMEM origin scalars, so
+            # no mask array exists (round 3 streamed one per step)
             parts.append(
-                (f"sharded fused: {n_pad} exchange-padded block(s) "
-                 f"(+{2 * m} z/y)", n_pad * padded_b))
+                (f"sharded fused: {nfields} exchange-padded block(s) "
+                 f"(+{2 * m} z/y)", nfields * padded_b))
         elif prefer_padfree(stencil, grid, batch=batch):
             parts.append(("pad-free fused: no pad transient", 0))
         else:
