@@ -237,10 +237,11 @@ def maybe_auto_fuse(cfg: RunConfig) -> RunConfig:
     else:
         from .ops.pallas.fused import make_fused_step, prefer_padfree
 
-        # probe the same variant build() will construct (pad-free above
-        # the HBM threshold — the 1024^3 path)
+        # probe the same variants build() will construct (pad-free above
+        # the HBM threshold — the 1024^3 path — with a padded fallback)
         if make_fused_step(st, cfg.grid, k,
-                           padfree=prefer_padfree(st, cfg.grid)) is None:
+                           padfree=prefer_padfree(st, cfg.grid)) is None \
+                and make_fused_step(st, cfg.grid, k) is None:
             return cfg  # untileable shape
         log.info("auto: temporal blocking k=%d (fused Pallas kernel)", k)
     return dataclasses.replace(cfg, fuse=k)
@@ -387,10 +388,13 @@ def build(cfg: RunConfig):
             from .ops.pallas.fused import make_fused_step, prefer_padfree
             # pad-free (9-block raw-grid) kernel for 1024^3-class grids,
             # where the padded path's full-grid pad transient exhausts HBM
-            fused = make_fused_step(
-                st, cfg.grid, cfg.fuse, periodic=cfg.periodic,
-                padfree=prefer_padfree(st, cfg.grid,
-                                       batch=cfg.ensemble or 1))
+            padfree = prefer_padfree(st, cfg.grid, batch=cfg.ensemble or 1)
+            fused = make_fused_step(st, cfg.grid, cfg.fuse,
+                                    periodic=cfg.periodic, padfree=padfree)
+            if fused is None and padfree:
+                # pad-free untileable (VMEM window gate): padded fallback
+                fused = make_fused_step(st, cfg.grid, cfg.fuse,
+                                        periodic=cfg.periodic)
             if fused is None:
                 raise ValueError(
                     f"--fuse {cfg.fuse} unsupported for {st.name} on grid "

@@ -391,23 +391,28 @@ def _fused_raw_kernel(micro, nfields, k, margin, halo, bz, by, shape,
         o[...] = f[wm:bz + wm, wm:by + wm, :]
 
 
-def _raw_window_specs(Z, Y, X, bz, by, m, periodic):
+def _tail_index_fns(extent, block, g, wrap):
+    """(pre, post) block-index functions for one windowed axis: blocks of
+    granularity ``g`` covering a tile's pre/post tails, WRAPPED (periodic)
+    or CLAMPED to the walls (guard-frame / slab-selected).  The single
+    definition of the wall-index convention for every 9-block kernel."""
+    nb = extent // g
+    r = block // g
+    if wrap:
+        return (lambda i: (i * r - 1) % nb,
+                lambda i: ((i + 1) * r) % nb)
+    return (lambda i: jnp.maximum(i * r - 1, 0),
+            lambda i: jnp.minimum((i + 1) * r, nb - 1))
+
+
+def _raw_window_specs(Z, Y, X, bz, by, m, wrap_z, wrap_y):
     """Nine BlockSpecs assembling one (bz+4m, by+4m, X) window from the raw
     grid.  Tail blocks have granularity g=2m (block-aligned origins); wall
-    tiles clamp (guard-frame mode) or wrap (periodic) their indices."""
+    tiles clamp (guard-frame mode / slab-selected walls) or wrap
+    (periodic) per axis."""
     g = 2 * m
-    nzb, nyb = Z // g, Y // g
-    rz, ry = bz // g, by // g
-    if periodic:
-        zp = lambda i: (i * rz - 1) % nzb          # noqa: E731
-        zn = lambda i: ((i + 1) * rz) % nzb        # noqa: E731
-        yp = lambda j: (j * ry - 1) % nyb          # noqa: E731
-        yn = lambda j: ((j + 1) * ry) % nyb        # noqa: E731
-    else:
-        zp = lambda i: jnp.maximum(i * rz - 1, 0)              # noqa: E731
-        zn = lambda i: jnp.minimum((i + 1) * rz, nzb - 1)      # noqa: E731
-        yp = lambda j: jnp.maximum(j * ry - 1, 0)              # noqa: E731
-        yn = lambda j: jnp.minimum((j + 1) * ry, nyb - 1)      # noqa: E731
+    zp, zn = _tail_index_fns(Z, bz, g, wrap_z)
+    yp, yn = _tail_index_fns(Y, by, g, wrap_y)
     return [
         pl.BlockSpec((g, g, X), lambda i, j: (zp(i), yp(j), 0)),
         pl.BlockSpec((g, by, X), lambda i, j: (zp(i), j, 0)),
@@ -419,6 +424,143 @@ def _raw_window_specs(Z, Y, X, bz, by, m, periodic):
         pl.BlockSpec((g, by, X), lambda i, j: (zn(i), j, 0)),
         pl.BlockSpec((g, g, X), lambda i, j: (zn(i), yn(j), 0)),
     ]
+
+
+def _fused_zslab_kernel(micro, nfields, k, margin, halo, bz, by, gshape,
+                        periodic, parity, nz_tiles, interpret, *refs):
+    """Sharded PAD-FREE kernel for z-only decompositions.
+
+    Like ``_fused_raw_kernel`` (9 clamped/wrapped blocks of the raw LOCAL
+    field), except the z-direction wall tiles select their pre/post window
+    rows from exchanged neighbor SLABS instead of clamp garbage — interior
+    shard faces need genuine remote values, which the clamp trick cannot
+    supply.  ``refs``: an SMEM (2,) int32 global-origin scalar first, then
+    per field 9 core views + 3 views of the lower-neighbor slab (m, Y, X)
+    + 3 of the upper, then ``nfields`` outputs.
+
+    Geometry: the assembled window spans local rows
+    ``[i*bz - 2m, i*bz + bz + 2m)``.  At the shard's z-walls the outer
+    ``2m`` rows decompose as m don't-care rows (outside even the exchange
+    width; temporal validity never reads them into a surviving cell) + m
+    slab rows, so ``concat([slab_row, slab_row])`` places the real slab
+    values exactly where validity needs them.  The y axis is whole on
+    every shard, so its walls are GLOBAL walls and the plain clamp/wrap
+    of ``_raw_window_specs`` stays sound.
+
+    Why this exists: the exchange-padded local block was the last
+    full-size transient in the 4096^3 budget (8.25 GiB f32 per device on
+    a 64-chip mesh) — with slabs as operands, config 5 fits in f32
+    (docs/STATE.md budget table).
+    """
+    wm = 2 * margin
+    origins, refs = refs[0], refs[1:]
+    per = 15
+    iz = pl.program_id(0)
+    fields = []
+    for f in range(nfields):
+        c = refs[per * f:per * f + 9]
+        zlo = refs[per * f + 9:per * f + 12]
+        zhi = refs[per * f + 12:per * f + 15]
+        rows_c = [
+            jnp.concatenate([c[r * 3][...], c[r * 3 + 1][...],
+                             c[r * 3 + 2][...]], axis=1)
+            for r in range(3)
+        ]
+        row_lo = jnp.concatenate([z[...] for z in zlo], axis=1)
+        row_hi = jnp.concatenate([z[...] for z in zhi], axis=1)
+        pre = jnp.where(iz == 0,
+                        jnp.concatenate([row_lo, row_lo], axis=0),
+                        rows_c[0])
+        post = jnp.where(iz == nz_tiles - 1,
+                         jnp.concatenate([row_hi, row_hi], axis=0),
+                         rows_c[2])
+        fields.append(jnp.concatenate([pre, rows_c[1], post], axis=0))
+    fields = tuple(fields)
+    like = fields[0]
+    outs = refs[per * nfields:]
+    frame, extra = _window_frame(
+        like.shape, origins[0] + iz * bz - wm,
+        origins[1] + pl.program_id(1) * by - wm, gshape, halo, periodic,
+        parity)
+    fields = _run_micros(micro, fields, frame, extra, k)
+    for o, f in zip(outs, fields):
+        o[...] = f[wm:bz + wm, wm:by + wm, :]
+
+
+def _zslab_specs(Lz, Y, X, bz, by, m, periodic):
+    """Specs for the z-sharded pad-free kernel: 9 core views (z CLAMPED —
+    wall values are replaced by the slab selects — y clamp/wrap) + 3 views
+    of an (m, Y, X) slab covering the window's y span.  The slab's m-row
+    extent is the MAJOR axis, so no sublane constraint applies to it; the
+    y views reuse the core tails' aligned sizes."""
+    g = 2 * m
+    yp, yn = _tail_index_fns(Y, by, g, wrap=periodic)
+    core = _raw_window_specs(Lz, Y, X, bz, by, m,
+                             wrap_z=False, wrap_y=periodic)
+    slab = [
+        pl.BlockSpec((m, g, X), lambda i, j: (0, yp(j), 0)),
+        pl.BlockSpec((m, by, X), lambda i, j: (0, j, 0)),
+        pl.BlockSpec((m, g, X), lambda i, j: (0, yn(j), 0)),
+    ]
+    return core, slab
+
+
+def build_zslab_padfree_call(
+    stencil: Stencil,
+    local_shape: Tuple[int, int, int],
+    global_shape: Tuple[int, int, int],
+    k: int,
+    tiles: Optional[Tuple[int, int]] = None,
+    interpret: Optional[bool] = None,
+    periodic: bool = False,
+):
+    """Sharded pad-free fused call (z-only decomposition).
+
+    The call takes: origins (int32 (2,)), then per field 9 views of the
+    raw LOCAL block + 3 views of the lower slab + 3 of the upper (pass
+    the block 9x and each slab 3x), and returns ``nfields`` local-shape
+    arrays advanced k steps.  Returns ``(call, margin, nfields)`` or None.
+    """
+    if not fused_supported(stencil):
+        return None
+    if interpret is None:
+        interpret = _interpret_default()
+    micro_factory, halo, nfields = _MICRO[stencil.name]
+    margin = k * _halo_per_micro(stencil)
+    Lz, Y, X = (int(s) for s in local_shape)
+    gz, gy, gx = (int(s) for s in global_shape)
+    if stencil.parity_sensitive and periodic and (gx % 2 or gy % 2
+                                                  or gz % 2):
+        return None
+    itemsize = jnp.dtype(stencil.dtype).itemsize
+    if tiles is None:
+        tiles = _pick_tiles(Lz, Y, X, margin, itemsize, nfields,
+                            wm=2 * margin)
+    if tiles is None:
+        return None
+    bz, by = tiles
+    micro = micro_factory(stencil, interpret)
+    grid = (Lz // bz, Y // by)
+    core, slab = _zslab_specs(Lz, Y, X, bz, by, margin, periodic)
+    per_field = core + slab + slab  # zlo and zhi share the y-view shapes
+    out_spec = pl.BlockSpec((bz, by, X), lambda i, j: (i, j, 0))
+    call = pl.pallas_call(
+        functools.partial(
+            _fused_zslab_kernel, micro, nfields, k, margin, halo, bz, by,
+            (gz, gy, gx), periodic, stencil.parity_sensitive, Lz // bz,
+            interpret),
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + per_field * nfields,
+        out_specs=[out_spec] * nfields,
+        out_shape=[jax.ShapeDtypeStruct((Lz, Y, X), stencil.dtype)
+                   for _ in range(nfields)],
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT_BYTES,
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )
+    return call, margin, nfields
 
 
 def _lane_round(n: int) -> int:
@@ -562,7 +704,9 @@ def build_fused_call(
     m = margin
     extra_specs = []
     if padfree:
-        per_field_specs = _raw_window_specs(Z, Y, X, bz, by, m, periodic)
+        per_field_specs = _raw_window_specs(Z, Y, X, bz, by, m,
+                                            wrap_z=periodic,
+                                            wrap_y=periodic)
         kernel = functools.partial(
             _fused_raw_kernel, micro, nfields, k, m, halo, bz, by,
             (Z, Y, X), periodic, stencil.parity_sensitive, interpret)

@@ -33,7 +33,7 @@ def _take(x: jax.Array, axis: int, start: int, size: int) -> jax.Array:
     return x[tuple(idx)]
 
 
-def exchange_pad_axis(
+def exchange_slabs_axis(
     x: jax.Array,
     axis: int,
     axis_name: Optional[str],
@@ -41,27 +41,28 @@ def exchange_pad_axis(
     halo: int,
     bc_value,
     periodic: bool = False,
-) -> jax.Array:
-    """Pad ``x`` with ``halo`` cells on both ends of ``axis``.
+) -> Tuple[jax.Array, jax.Array]:
+    """The two halo slabs for ``axis``, UNconcatenated: ``(left, right)``.
 
-    Interior faces receive the neighbor shard's border slab (ppermute);
-    global faces receive ``bc_value`` (or wrap around when ``periodic``).
-    With ``n_shards == 1`` (or no mesh axis) this degrades to a local pad/roll,
-    so the same step code serves sharded and unsharded axes.
+    ``left`` is what belongs just before this shard's rows (the lower
+    neighbor's last ``halo`` rows), ``right`` just after.  Interior faces
+    receive the neighbor's border slab (ppermute); global faces receive
+    ``bc_value`` (or wrap when ``periodic``).  Callers that need the
+    classic padded block concatenate (``exchange_pad_axis``); the pad-free
+    sharded kernels hand the slabs to the kernel as separate operands so
+    no padded copy of the block is ever materialized.
     """
     hi_slab = _take(x, axis, x.shape[axis] - halo, halo)  # my last rows
     lo_slab = _take(x, axis, 0, halo)  # my first rows
 
     if axis_name is None or n_shards == 1:
         if periodic:
-            left, right = hi_slab, lo_slab
-        else:
-            bc = jnp.asarray(bc_value, x.dtype)
-            shape = list(x.shape)
-            shape[axis] = halo
-            left = jnp.full(shape, bc, x.dtype)
-            right = left
-        return jnp.concatenate([left, x, right], axis=axis)
+            return hi_slab, lo_slab
+        bc = jnp.asarray(bc_value, x.dtype)
+        shape = list(x.shape)
+        shape[axis] = halo
+        left = jnp.full(shape, bc, x.dtype)
+        return left, left
 
     # Downward shift: shard i's hi_slab -> shard i+1's left halo.
     down = [(i, (i + 1) % n_shards) for i in range(n_shards)]
@@ -81,7 +82,28 @@ def exchange_pad_axis(
         from_left = jnp.where(idx == 0, bc, from_left)
         from_right = jnp.where(idx == n_shards - 1, bc, from_right)
 
-    return jnp.concatenate([from_left, x, from_right], axis=axis)
+    return from_left, from_right
+
+
+def exchange_pad_axis(
+    x: jax.Array,
+    axis: int,
+    axis_name: Optional[str],
+    n_shards: int,
+    halo: int,
+    bc_value,
+    periodic: bool = False,
+) -> jax.Array:
+    """Pad ``x`` with ``halo`` cells on both ends of ``axis``.
+
+    Interior faces receive the neighbor shard's border slab (ppermute);
+    global faces receive ``bc_value`` (or wrap around when ``periodic``).
+    With ``n_shards == 1`` (or no mesh axis) this degrades to a local pad/roll,
+    so the same step code serves sharded and unsharded axes.
+    """
+    left, right = exchange_slabs_axis(
+        x, axis, axis_name, n_shards, halo, bc_value, periodic)
+    return jnp.concatenate([left, x, right], axis=axis)
 
 
 def exchange_and_pad(

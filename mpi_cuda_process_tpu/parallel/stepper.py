@@ -225,6 +225,7 @@ def make_sharded_fused_step(
     k: int,
     interpret: Optional[bool] = None,
     periodic: bool = False,
+    padfree: Optional[bool] = None,
 ):
     """Temporal blocking under domain decomposition: k steps per exchange.
 
@@ -260,8 +261,21 @@ def make_sharded_fused_step(
     fields (wave's u_prev is read pointwise across the shrinking validity
     window), so the per-field-halo elision that applies to single steps
     does not apply here.
+
+    ``padfree`` (z-only decompositions): hand the exchanged slabs to the
+    kernel as separate operands instead of materializing the exchange-
+    padded local block (``fused.build_zslab_padfree_call``) — the padded
+    block was the last full-size transient in the 4096^3 budget.
+    ``None`` = auto: pad-free when the padded copies would exceed the
+    same HBM threshold the single-chip path uses (``prefer_padfree`` on
+    the local block), padded (the measured configuration) below it.
     """
-    from ..ops.pallas.fused import build_fused_call, fused_supported
+    from ..ops.pallas.fused import (
+        build_fused_call,
+        build_zslab_padfree_call,
+        fused_supported,
+        prefer_padfree,
+    )
 
     ndim = stencil.ndim
     if ndim != 3 or not fused_supported(stencil):
@@ -272,6 +286,21 @@ def make_sharded_fused_step(
     if any(g % c for g, c in zip(global_shape, counts)):
         return None
     local_shape = tuple(g // c for g, c in zip(global_shape, counts))
+
+    z_only = counts[1] == 1
+    if padfree is None:
+        padfree = z_only and prefer_padfree(stencil, local_shape)
+    if padfree and z_only:
+        step = _make_zslab_padfree_step(
+            stencil, mesh, global_shape, local_shape, axis_names, counts,
+            k, build_zslab_padfree_call, interpret, periodic)
+        if step is not None:
+            return step
+        # z-slab builder declined (typically the VMEM window gate at very
+        # wide X): fall through to the padded kernel rather than turning a
+        # previously-working config into None
+    # (padfree requested but mesh shards y too: same padded fallback —
+    # the clamp/slab trick needs whole y on every shard)
     # Periodic keeps frame identically False (no origins needed): wrap
     # halos arrive via the exchange, and parity stays globally consistent
     # because shard origins/extents are even (alignment gates).  The
@@ -309,6 +338,46 @@ def make_sharded_fused_step(
                 for d in (0, 1)], dtype=jnp.int32)
             args = [origins] + args
         return tuple(call(*args))
+
+    return shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=spec,
+        check_vma=False,
+    )
+
+
+def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
+                             axis_names, counts, k, build_call, interpret,
+                             periodic):
+    """shard_map wrapper for the z-slab pad-free fused kernel: width-m
+    slab exchange (no concatenation, no padded copy), slabs handed to the
+    kernel as operands, frame from SMEM origin scalars."""
+    from ..ops.pallas.fused import _halo_per_micro
+
+    m = k * _halo_per_micro(stencil)
+    built = build_call(stencil, local_shape,
+                       tuple(int(g) for g in global_shape), k,
+                       interpret=interpret, periodic=periodic)
+    if built is None:
+        return None
+    call, m_built, nfields = built
+    assert m_built == m
+    spec = grid_partition_spec(3, mesh)
+
+    def local_step(fields: Fields) -> Fields:
+        from .halo import exchange_slabs_axis
+
+        args = []
+        for f, bc in zip(fields, stencil.bc_value):
+            lo, hi = exchange_slabs_axis(
+                f, 0, axis_names[0], counts[0], m, bc, periodic=periodic)
+            args += [f] * 9 + [lo] * 3 + [hi] * 3
+        origins = jnp.array([
+            lax.axis_index(axis_names[0]) * local_shape[0]
+            if axis_names[0] else 0, 0], dtype=jnp.int32)
+        return tuple(call(origins, *args))
 
     return shard_map(
         local_step,
