@@ -156,3 +156,23 @@ def test_stale_fallback_replays_only_local_measurements(bench, tmp_path):
         assert rec2["stale"] is True
     finally:
         bench._CACHE = old
+
+
+def test_mktable_regenerates_from_campaign(capsys):
+    """benchmarks/mktable.py renders the measured table from a results
+    file with the LIVE auto-policy picks bolded — the mechanism that
+    keeps BASELINE.md and cli.py from silently disagreeing."""
+    import subprocess
+    import sys as _sys
+
+    out = subprocess.run(
+        [_sys.executable, os.path.join(REPO, "benchmarks", "mktable.py"),
+         "--in", os.path.join(REPO, "benchmarks", "results_r03.json")],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    body = out.stdout
+    assert "| Config | compute | Mcells/s | ms/step |" in body
+    # the r03 auto winners appear bolded per the live policy tables
+    assert "**fused4**" in body and "**106,978**" in body
+    # errored labels surface as pending, not silently dropped
+    assert "Pending / errored / suspect" in body
