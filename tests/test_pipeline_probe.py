@@ -33,3 +33,46 @@ def test_probe_builds_and_doubles(probe_mod, name):
     fn = probe_mod.build_probe(name, shape, bz=2, interpret=True)
     x = jnp.arange(np.prod(shape), dtype=jnp.float32).reshape(shape)
     np.testing.assert_array_equal(np.asarray(fn(x)), 2.0 * np.asarray(x))
+
+
+def test_zslab_probe_child_template_is_valid():
+    """The zslab VMEM probe's child code must be syntactically valid and
+    its construction path must work (interpret mode, tiny shape) — a
+    healthy-tunnel window must never be spent on a harness bug."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "zslab_probe_smoke", os.path.join(REPO, "benchmarks",
+                                          "zslab_probe.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # template formats + compiles for every attempt row
+    for label, name, dt, local, k, tiles in mod.ATTEMPTS:
+        code = mod._CHILD.format(repo=REPO, name=name, dt=dt, local=local,
+                                 k=k, tiles=tiles)
+        compile(code, label, "exec")
+    # the construction path itself, tiny, interpret mode
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_cuda_process_tpu import make_stencil
+    from mpi_cuda_process_tpu.ops.pallas.fused import (
+        build_zslab_padfree_call,
+    )
+
+    st = make_stencil("wave3d")
+    local = (16, 16, 128)
+    built = build_zslab_padfree_call(st, local, (128, 16, 128), 4,
+                                     tiles=(8, 8), interpret=True)
+    assert built is not None
+    call, m, nfields = built
+    key = jax.random.PRNGKey(0)
+    fields = [jax.random.uniform(jax.random.fold_in(key, i), local,
+                                 st.dtype) for i in range(nfields)]
+    slab = jnp.zeros((m, 16, 128), st.dtype)
+    origins = jnp.array([16, 0], jnp.int32)
+    args = []
+    for f in fields:
+        args += [f] * 9 + [slab] * 3 + [slab] * 3
+    out = call(origins, *args)
+    assert np.isfinite(np.asarray(out[0])).all()
