@@ -130,3 +130,9 @@ def test_wave_zslab_untileable_falls_back_to_padded_estimate():
     with pytest.raises(ValueError):
         budget.check_budget(st, (4096,) * 3, mesh=(64, 1, 1), fuse=4,
                             hbm_bytes=V5E_HBM)
+
+
+def test_2d_fuse_budget_counts_fullgrid_pad():
+    t_plain = _total("life", (2048, 2048))
+    t_fused = _total("life", (2048, 2048), fuse=16)
+    assert t_fused > 0 and t_plain > 0  # both paths covered, no crash

@@ -442,3 +442,41 @@ def test_padfree_step_property(case, z, y, x, k, periodic, seed):
         np.testing.assert_allclose(
             np.asarray(g, np.float32), np.asarray(r, np.float32),
             rtol=0, atol=1e-3)
+
+
+@pytest.mark.slow
+@settings(max_examples=6, **_SETTINGS)
+@given(
+    case=hs.sampled_from(_PALLAS_CASES),
+    nz=hs.sampled_from([2, 4]),
+    lz=hs.sampled_from([16, 24]),
+    y=hs.sampled_from([16, 32]),
+    k=hs.sampled_from([4, 8]),
+    periodic=hs.booleans(),
+    seed=hs.integers(0, 2**16),
+)
+def test_zslab_padfree_sharded_property(case, nz, lz, y, k, periodic, seed):
+    """The z-slab pad-free sharded step either declines or matches k
+    plain steps — free shard counts, local extents, boundary modes."""
+    from mpi_cuda_process_tpu import make_mesh, shard_fields
+    from mpi_cuda_process_tpu.parallel.stepper import make_sharded_fused_step
+
+    name, kw = case
+    st = make_stencil(name, **kw)
+    grid = (nz * lz, y, 128)
+    mesh = make_mesh((nz, 1, 1))
+    fused = make_sharded_fused_step(st, mesh, grid, k, interpret=True,
+                                    periodic=periodic, padfree=True)
+    if fused is None:
+        return
+    fields = init_state(st, grid, seed=seed, density=0.3, kind="auto",
+                        periodic=periodic)
+    ref = fields
+    step = make_step(st, grid, periodic=periodic)
+    for _ in range(k):
+        ref = step(ref)
+    got = fused(shard_fields(fields, mesh, 3))
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(r, np.float32),
+            rtol=0, atol=1e-3)
