@@ -155,3 +155,55 @@ def advect2d_step_native(grid: np.ndarray, cy: float, cx: float) -> np.ndarray:
 def sor2d_step_native(grid: np.ndarray, omega: float) -> np.ndarray:
     """Independent C++ red-black SOR step (Gauss-Seidel semantics)."""
     return _step_2d_native("stencilhost_sor2d_step", grid, omega)
+
+
+def wave2d_step_native(u: np.ndarray, uprev: np.ndarray,
+                       c2dt2: float) -> np.ndarray:
+    """Independent C++ leapfrog wave step; returns the new u (the caller
+    carries the old u as the next u_prev, like the scan carry)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    a = np.ascontiguousarray(u, dtype=np.float32)
+    p = np.ascontiguousarray(uprev, dtype=np.float32)
+    out = np.empty_like(a)
+    lib.stencilhost_wave2d_step(
+        a.ctypes.data_as(ctypes.c_void_p), p.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(a.shape[0]), ctypes.c_int64(a.shape[1]),
+        ctypes.c_float(c2dt2))
+    return out
+
+
+def grayscott2d_step_native(u: np.ndarray, v: np.ndarray, du: float,
+                            dv: float, f: float, kappa: float):
+    """Independent C++ Gray-Scott step; returns (new_u, new_v)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    a = np.ascontiguousarray(u, dtype=np.float32)
+    b = np.ascontiguousarray(v, dtype=np.float32)
+    out_u = np.empty_like(a)
+    out_v = np.empty_like(b)
+    lib.stencilhost_grayscott2d_step(
+        a.ctypes.data_as(ctypes.c_void_p), b.ctypes.data_as(ctypes.c_void_p),
+        out_u.ctypes.data_as(ctypes.c_void_p),
+        out_v.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(a.shape[0]), ctypes.c_int64(a.shape[1]),
+        ctypes.c_float(du), ctypes.c_float(dv), ctypes.c_float(f),
+        ctypes.c_float(kappa))
+    return out_u, out_v
+
+
+def heat3d27_step_native(grid: np.ndarray, alpha: float) -> np.ndarray:
+    """Independent C++ 27-point high-order diffusion step."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    a = np.ascontiguousarray(grid, dtype=np.float32)
+    out = np.empty_like(a)
+    lib.stencilhost_heat3d27_step(
+        a.ctypes.data_as(ctypes.c_void_p), out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(a.shape[0]), ctypes.c_int64(a.shape[1]),
+        ctypes.c_int64(a.shape[2]), ctypes.c_float(alpha))
+    return out

@@ -266,6 +266,71 @@ void stencilhost_advect2d_step(const float* in, float* out, int64_t h,
   }
 }
 
+// One leapfrog FDTD wave step (2D): u_new = 2u - u_prev + c2dt2*Lap(u),
+// frame keeps the old u (Dirichlet by induction — ops/wave.py); the caller
+// carries the old u as the next u_prev, exactly like the scan carry.
+void stencilhost_wave2d_step(const float* u, const float* uprev, float* out,
+                             int64_t h, int64_t w, float c2dt2) {
+  std::memcpy(out, u, sizeof(float) * static_cast<size_t>(h * w));
+  for (int64_t y = 1; y + 1 < h; ++y) {
+    for (int64_t x = 1; x + 1 < w; ++x) {
+      int64_t i = y * w + x;
+      float lap = u[i - 1] + u[i + 1] + u[i - w] + u[i + w] - 4.0f * u[i];
+      out[i] = 2.0f * u[i] - uprev[i] + c2dt2 * lap;
+    }
+  }
+}
+
+// One Gray-Scott reaction-diffusion step (2D, both fields halo'd):
+// u' = u + Du*Lap(u) - u v^2 + F (1-u); v' = v + Dv*Lap(v) + u v^2 -
+// (F+kappa) v (ops/reaction.py), frames fixed.
+void stencilhost_grayscott2d_step(const float* u, const float* v,
+                                  float* out_u, float* out_v, int64_t h,
+                                  int64_t w, float du, float dv, float f,
+                                  float kappa) {
+  std::memcpy(out_u, u, sizeof(float) * static_cast<size_t>(h * w));
+  std::memcpy(out_v, v, sizeof(float) * static_cast<size_t>(h * w));
+  for (int64_t y = 1; y + 1 < h; ++y) {
+    for (int64_t x = 1; x + 1 < w; ++x) {
+      int64_t i = y * w + x;
+      float lap_u = u[i - 1] + u[i + 1] + u[i - w] + u[i + w] - 4.0f * u[i];
+      float lap_v = v[i - 1] + v[i + 1] + v[i - w] + v[i + w] - 4.0f * v[i];
+      float uvv = u[i] * v[i] * v[i];
+      out_u[i] = u[i] + du * lap_u - uvv + f * (1.0f - u[i]);
+      out_v[i] = v[i] + dv * lap_v + uvv - (f + kappa) * v[i];
+    }
+  }
+}
+
+// One 27-point high-order diffusion step (3D), frame fixed.  Weights by
+// neighbor class (face 14/30, edge 3/30, corner 1/30, center -128/30 —
+// ops/heat.py::heat3d27's discrete operator).
+void stencilhost_heat3d27_step(const float* in, float* out, int64_t d,
+                               int64_t h, int64_t w, float alpha) {
+  const float wface = 14.0f / 30.0f, wedge = 3.0f / 30.0f,
+              wcorner = 1.0f / 30.0f, wcenter = -128.0f / 30.0f;
+  std::memcpy(out, in, sizeof(float) * static_cast<size_t>(d * h * w));
+  for (int64_t z = 1; z + 1 < d; ++z) {
+    for (int64_t y = 1; y + 1 < h; ++y) {
+      for (int64_t x = 1; x + 1 < w; ++x) {
+        int64_t i = (z * h + y) * w + x;
+        float acc = wcenter * in[i];
+        for (int dz = -1; dz <= 1; ++dz) {
+          for (int dy = -1; dy <= 1; ++dy) {
+            for (int dx = -1; dx <= 1; ++dx) {
+              int nz = (dz != 0) + (dy != 0) + (dx != 0);
+              if (nz == 0) continue;
+              float wgt = nz == 1 ? wface : (nz == 2 ? wedge : wcorner);
+              acc += wgt * in[i + (dz * h + dy) * w + dx];
+            }
+          }
+        }
+        out[i] = in[i] + alpha * acc;
+      }
+    }
+  }
+}
+
 // One red-black SOR step (2D Laplace): red half-sweep (even coordinate
 // parity) then black, the black sweep reading fresh red values; frame fixed.
 void stencilhost_sor2d_step(const float* in, float* out, int64_t h, int64_t w,
