@@ -27,7 +27,6 @@ time.
 from __future__ import annotations
 
 import argparse
-import functools
 import json
 import math
 import os

@@ -18,7 +18,6 @@ the whole run.
 
 from __future__ import annotations
 
-import functools
 from typing import Sequence, Tuple
 
 import jax
