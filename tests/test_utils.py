@@ -1,0 +1,97 @@
+"""Direct unit tests for the utility layer.
+
+These modules were previously covered only through CLI flows (SURVEY.md
+§5.5 diagnostics, C7 renderer, mesh factoring, the round-4 slab
+exchange): a regression inside one of them would have surfaced as an
+opaque CLI-test failure.  Pin their contracts directly.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_process_tpu import init_state, make_step, make_stencil
+from mpi_cuda_process_tpu.parallel.mesh import factor_mesh
+from mpi_cuda_process_tpu.utils import budget, diagnostics, render
+
+
+def test_factor_mesh_balanced():
+    assert factor_mesh(8, 3) == (2, 2, 2)
+    assert factor_mesh(4, 2) == (2, 2)
+    assert factor_mesh(1, 3) == (1, 1, 1)
+    assert factor_mesh(64, 3) == (4, 4, 4)
+    # non-power-of-two: product preserved, descending balance
+    shape = factor_mesh(6, 3)
+    assert np.prod(shape) == 6 and len(shape) == 3
+
+
+def test_ascii_render_int_glyphs_and_float_ramp():
+    ints = np.zeros((8, 8), np.int32)
+    ints[2, 3] = 1
+    art = render.ascii_render(ints)
+    assert "0" in art and art.count("\n") >= 7  # alive glyph, row per line
+    floats = np.linspace(0, 100, 64, dtype=np.float32).reshape(8, 8)
+    art_f = render.ascii_render(floats)
+    assert len(set(art_f) - {"\n"}) > 2  # a ramp, not a binary glyph
+    # 3D renders its middle z-slice (index d//2); a gradient there must
+    # produce non-blank glyphs, and the other slices must not leak in
+    vol = np.zeros((4, 8, 8), np.float32)
+    vol[2] = np.linspace(0, 100, 64, dtype=np.float32).reshape(8, 8)
+    assert render.ascii_render(vol) == render.ascii_render(vol[2])
+    assert render.ascii_render(vol).strip() != ""
+    with pytest.raises(ValueError):
+        render.ascii_render(np.zeros((2, 2, 2, 2)))
+
+
+def test_field_diagnostics_per_family():
+    life = make_stencil("life")
+    f = init_state(life, (16, 128), seed=1, density=0.4, kind="random")
+    d = diagnostics.field_diagnostics(life, f)
+    assert d["population"] == float(jnp.sum(f[0]))
+
+    wave = make_stencil("wave2d")
+    fw = init_state(wave, (16, 128), kind="pulse")
+    dw = diagnostics.field_diagnostics(wave, fw)
+    assert "velocity_l2" in dw and np.isfinite(dw["velocity_l2"])
+
+    heat = make_stencil("heat2d")
+    fh = init_state(heat, (16, 128), kind="zero")
+    step = make_step(heat, (16, 128))
+    dh = diagnostics.field_diagnostics(heat, fh, step_fn=step)
+    assert {"mean", "min", "max", "residual"} <= set(dh)
+    assert dh["residual"] > 0  # cold interior vs hot walls: not converged
+    line = diagnostics.format_diagnostics(dh)
+    assert "residual" in line
+
+
+def test_residual_norm_vanishes_at_fixed_point():
+    heat = make_stencil("heat2d")
+    shape = (16, 128)
+    # the all-hot state equals the Dirichlet walls: an exact fixed point
+    fields = (jnp.full(shape, 100.0, jnp.float32),)
+    step = make_step(heat, shape)
+    assert diagnostics.residual_norm(step, fields) == 0.0
+
+
+def test_exchange_slabs_axis_unsharded_contract():
+    from mpi_cuda_process_tpu.parallel.halo import exchange_slabs_axis
+
+    x = jnp.arange(12.0, dtype=jnp.float32).reshape(4, 3)
+    # unsharded guard-frame: both slabs are the bc constant
+    lo, hi = exchange_slabs_axis(x, 0, None, 1, 1, bc_value=7.0)
+    assert lo.shape == (1, 3) and float(lo[0, 0]) == 7.0
+    assert jnp.array_equal(lo, hi)
+    # unsharded periodic: slabs are the wrapped edge rows
+    lo_p, hi_p = exchange_slabs_axis(x, 0, None, 1, 1, bc_value=0.0,
+                                     periodic=True)
+    assert jnp.array_equal(lo_p[0], x[-1])
+    assert jnp.array_equal(hi_p[0], x[0])
+
+
+def test_device_hbm_bytes_and_format():
+    # CPU backend reports something or falls back to the v5e default —
+    # either way a positive integer the guard can divide by
+    assert budget.device_hbm_bytes() > 0
+    txt = budget.format_budget(
+        3 * 2**30, [("state", 2 * 2**30), ("pad", 2**30)], 16 * 2**30)
+    assert "TOTAL per device" in txt and "16.00" in txt
