@@ -3,6 +3,7 @@
 import numpy as np
 
 import jax.numpy as jnp
+import pytest
 
 from mpi_cuda_process_tpu.cli import build, config_from_args, run
 from mpi_cuda_process_tpu.config import RunConfig, parse_int_tuple, parse_params
@@ -386,3 +387,33 @@ def test_auto_full_2d_policy_table(monkeypatch):
     # unaligned width declines at the builder
     assert cli.maybe_auto_fuse(
         RunConfig(stencil="life", grid=(64, 100), iters=16)).fuse == 0
+
+
+@pytest.mark.parametrize(
+    "label,args",
+    [
+        # Scaled-down analogues of the five BASELINE.json configs: same
+        # stencil/mesh STRUCTURE, tiny extents, run end-to-end through the
+        # CLI on the virtual device mesh.  What this pins: every north-star
+        # config is expressible as one command line and actually executes
+        # (SURVEY.md §5.6 'every BASELINE.json config expressible').
+        ("config1_2d5pt", ["--stencil", "heat2d", "--grid", "64,128",
+                           "--iters", "20"]),
+        ("config2_3d7pt_single", ["--stencil", "heat3d",
+                                  "--grid", "16,16,128", "--iters", "10"]),
+        ("config3_3d7pt_2x2", ["--stencil", "heat3d", "--grid", "16,16,128",
+                               "--iters", "10", "--mesh", "2,2,1"]),
+        ("config4_27pt_8chip", ["--stencil", "heat3d27",
+                                "--grid", "16,16,128", "--iters", "6",
+                                "--mesh", "4,2,1"]),
+        # bf16's sublane tile (16) requires k=8 temporal blocking
+        ("config5_wave_fused_sharded", [
+            "--stencil", "wave3d", "--grid", "32,32,128", "--iters", "16",
+            "--mesh", "2,1,1", "--fuse", "8", "--dtype", "bfloat16"]),
+    ],
+)
+def test_baseline_config_analogues_run_end_to_end(label, args):
+    fields, mcells = run(config_from_args(args))
+    arr = np.asarray(fields[0], dtype=np.float32)
+    assert np.isfinite(arr).all(), label
+    assert mcells > 0, label
