@@ -322,10 +322,11 @@ def _fused_kernel(micro, nfields, k, margin, halo, bz, by, shape, periodic,
         o[...] = f[margin:bz + margin, margin:by + margin, :]
 
 
-def _window_frame(win_shape, z0, y0, shape, halo, periodic, parity):
+def _window_frame(win_shape, z0, y0, shape, halo, periodic, parity, x0=0):
     """(frame mask, parity extra) for a window whose global origin is
-    (z0, y0, 0).  Shared by the padded and pad-free kernels — the single
+    (z0, y0, x0).  Shared by every fused kernel variant — the single
     definition of the guard-frame predicate and the red-black coloring.
+    ``x0`` is nonzero only for the wide-X kernels (x windowed too).
 
     Global coordinate parity: Z/Y/X are even by the tileability gates, so
     the periodic wrap keeps the coloring consistent; jnp's ``%`` is a
@@ -335,7 +336,7 @@ def _window_frame(win_shape, z0, y0, shape, halo, periodic, parity):
     Z, Y, X = shape
     zidx = jax.lax.broadcasted_iota(jnp.int32, win_shape, 0) + z0
     yidx = jax.lax.broadcasted_iota(jnp.int32, win_shape, 1) + y0
-    xidx = jax.lax.broadcasted_iota(jnp.int32, win_shape, 2)
+    xidx = jax.lax.broadcasted_iota(jnp.int32, win_shape, 2) + x0
     if periodic:
         frame = jnp.zeros(win_shape, jnp.bool_)
     else:
@@ -502,6 +503,186 @@ def _zslab_specs(Lz, Y, X, bz, by, m, periodic):
         pl.BlockSpec((m, by, X), lambda i, j: (0, j, 0)),
         pl.BlockSpec((m, g, X), lambda i, j: (0, yn(j), 0)),
     ]
+    return core, slab
+
+
+_XWIN_GX = 128  # x-margin/granularity: one lane tile (>= any margin m)
+
+
+def _pick_xwin_tiles(Lz, Y, X, margin, itemsize, nfields):
+    """(bz, by, bx) for the wide-X kernel — the SAME sublane gate, VMEM
+    cost model, and scoring as ``_pick_tiles`` (delegated there, so a
+    recalibration of the live-copy model applies to every picker), with
+    the lane axis iterated over its own candidate ladder."""
+    best = None
+    for bx in (2048, 1024, 512, 256, 128):
+        if X % bx or bx % _XWIN_GX:
+            continue
+        tiles = _pick_tiles(Lz, Y, bx + 2 * _XWIN_GX, margin, itemsize,
+                            nfields, wm=2 * margin)
+        if tiles is None:
+            continue
+        bz, by = tiles
+        window = ((bz + 4 * margin) * (by + 4 * margin)
+                  * (bx + 2 * _XWIN_GX))
+        core = bz * by * bx
+        score = (core / window, core)
+        if best is None or score > best[0]:
+            best = (score, (bz, by, bx))
+    return best[1] if best else None
+
+
+def build_zslab_xwin_call(
+    stencil: Stencil,
+    local_shape: Tuple[int, int, int],
+    global_shape: Tuple[int, int, int],
+    k: int,
+    tiles: Optional[Tuple[int, int, int]] = None,
+    interpret: Optional[bool] = None,
+    periodic: bool = False,
+):
+    """Wide-X sharded pad-free fused call (z-only decomposition, x
+    windowed at lane-tile granularity).
+
+    The fallback when ``build_zslab_padfree_call``'s whole-row windows
+    exceed VMEM (wide X x multi-field).  The call takes: origins (int32
+    (2,)), then per field 27 core views + 9 views of each z-slab (pass
+    the block 27x and each slab 9x), and returns ``nfields`` local-shape
+    arrays advanced k steps.  Returns ``(call, margin, nfields)`` or
+    None.  Read amplification is the price: (1+4m/bz)(1+4m/by)
+    (1+2*128/bx) — still a large net traffic win at k steps/pass vs the
+    cliff-regime jnp path, which is why this exists for config-5 wave.
+    """
+    if not fused_supported(stencil):
+        return None
+    if interpret is None:
+        interpret = _interpret_default()
+    micro_factory, halo, nfields = _MICRO[stencil.name]
+    margin = k * _halo_per_micro(stencil)
+    if _XWIN_GX < margin:
+        return None  # x shell must absorb the full validity margin
+    Lz, Y, X = (int(s) for s in local_shape)
+    gz, gy, gxx = (int(s) for s in global_shape)
+    if stencil.parity_sensitive and periodic and (gxx % 2 or gy % 2
+                                                 or gz % 2):
+        return None
+    itemsize = jnp.dtype(stencil.dtype).itemsize
+    if tiles is None:
+        tiles = _pick_xwin_tiles(Lz, Y, X, margin, itemsize, nfields)
+    if tiles is None:
+        return None
+    bz, by, bx = tiles
+    if bx >= X:
+        return None  # whole-row windows: use the plain z-slab kernel
+    micro = micro_factory(stencil, interpret)
+    grid = (Lz // bz, Y // by, X // bx)
+    core, slab = _xwin_specs(Lz, Y, X, bz, by, bx, margin, periodic)
+    per_field = core + slab + slab
+    out_spec = pl.BlockSpec((bz, by, bx), lambda i, j, l: (i, j, l))
+    call = pl.pallas_call(
+        functools.partial(
+            _fused_zslab_xwin_kernel, micro, nfields, k, margin, halo,
+            bz, by, bx, (gz, gy, gxx), periodic,
+            stencil.parity_sensitive, Lz // bz, interpret),
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + per_field * nfields,
+        out_specs=[out_spec] * nfields,
+        out_shape=[jax.ShapeDtypeStruct((Lz, Y, X), stencil.dtype)
+                   for _ in range(nfields)],
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT_BYTES,
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+    )
+    return call, margin, nfields
+
+
+def _fused_zslab_xwin_kernel(micro, nfields, k, margin, halo, bz, by, bx,
+                             gshape, periodic, parity, nz_tiles, interpret,
+                             *refs):
+    """Wide-X variant of ``_fused_zslab_kernel``: the x (lane) axis is
+    windowed too, at ``_XWIN_GX``-lane granularity — for grids whose full
+    X extent makes whole-row windows exceed VMEM (two-field wave3d at
+    X=4096 lanes, the config-5 gap in docs/STATE.md's budget table).
+
+    Geometry per field: 27 core views (3x3x3 pre/core/post in z, y, x;
+    z/y tails at 2m granularity, x tails one lane tile) + 9 views of each
+    z-slab (3x3 in y, x).  The window is (bz+4m, by+4m, bx+2*GX); lane
+    rolls wrap at the WINDOW extent, and the wrap garbage lands in the
+    outer GX-lane x shell, which the output inset (GX >= m) excludes —
+    the same temporal-validity argument as the z/y margins.  x walls are
+    GLOBAL walls (x is never sharded), so the clamp/wrap spec trick is
+    sound there; only the z walls need the slab selects.
+    """
+    wm = 2 * margin
+    gx = _XWIN_GX
+    origins, refs = refs[0], refs[1:]
+    per = 27 + 9 + 9
+    iz = pl.program_id(0)
+    fields = []
+    for f in range(nfields):
+        base = per * f
+        # three x-positions, each a z/y 3x3 of 9 refs, concatenated in x
+        subs = []
+        for t in range(3):
+            subs.append(_assemble_window3x3(
+                refs[base + 9 * t:base + 9 * t + 9]))
+        win_c = jnp.concatenate(subs, axis=2)
+        lo_refs = refs[base + 27:base + 36]
+        hi_refs = refs[base + 36:base + 45]
+        row_lo = jnp.concatenate(
+            [jnp.concatenate([r[...] for r in lo_refs[3 * t:3 * t + 3]],
+                             axis=1) for t in range(3)], axis=2)
+        row_hi = jnp.concatenate(
+            [jnp.concatenate([r[...] for r in hi_refs[3 * t:3 * t + 3]],
+                             axis=1) for t in range(3)], axis=2)
+        pre = jnp.where(iz == 0,
+                        jnp.concatenate([row_lo, row_lo], axis=0),
+                        win_c[:wm])
+        post = jnp.where(iz == nz_tiles - 1,
+                         jnp.concatenate([row_hi, row_hi], axis=0),
+                         win_c[bz + wm:])
+        fields.append(jnp.concatenate([pre, win_c[wm:bz + wm], post],
+                                      axis=0))
+    fields = tuple(fields)
+    like = fields[0]
+    outs = refs[per * nfields:]
+    frame, extra = _window_frame(
+        like.shape, origins[0] + iz * bz - wm,
+        origins[1] + pl.program_id(1) * by - wm, gshape, halo, periodic,
+        parity, x0=pl.program_id(2) * bx - gx)
+    fields = _run_micros(micro, fields, frame, extra, k)
+    for o, f in zip(outs, fields):
+        o[...] = f[wm:bz + wm, wm:by + wm, gx:bx + gx]
+
+
+def _xwin_specs(Lz, Y, X, bz, by, bx, m, periodic):
+    """(27 core specs ordered x-position-major then z/y 3x3, 9 slab
+    specs) for the wide-X z-slab kernel."""
+    g = 2 * m
+    gx = _XWIN_GX
+    zp, zn = _tail_index_fns(Lz, bz, g, wrap=False)  # slab selects own walls
+    yp, yn = _tail_index_fns(Y, by, g, wrap=periodic)
+    xp, xn = _tail_index_fns(X, bx, gx, wrap=periodic)
+    zpos = [(g, zp), (bz, lambda i: i), (g, zn)]
+    ypos = [(g, yp), (by, lambda j: j), (g, yn)]
+    xpos = [(gx, xp), (bx, lambda l: l), (gx, xn)]
+    core = []
+    for xs, xf in xpos:
+        for zs, zf in zpos:
+            for ys, yf in ypos:
+                core.append(pl.BlockSpec(
+                    (zs, ys, xs),
+                    (lambda zf=zf, yf=yf, xf=xf:
+                     lambda i, j, l: (zf(i), yf(j), xf(l)))()))
+    slab = []
+    for xs, xf in xpos:
+        for ys, yf in ypos:
+            slab.append(pl.BlockSpec(
+                (m, ys, xs),
+                (lambda yf=yf, xf=xf:
+                 lambda i, j, l: (0, yf(j), xf(l)))()))
     return core, slab
 
 

@@ -293,12 +293,20 @@ def make_sharded_fused_step(
     if padfree and z_only:
         step = _make_zslab_padfree_step(
             stencil, mesh, global_shape, local_shape, axis_names, counts,
-            k, build_zslab_padfree_call, interpret, periodic)
+            k, build_zslab_padfree_call, (9, 3), interpret, periodic)
+        if step is None:
+            # whole-row windows exceed VMEM (wide X x multi-field): the
+            # wide-X kernel windows the lane axis too
+            from ..ops.pallas.fused import build_zslab_xwin_call
+
+            step = _make_zslab_padfree_step(
+                stencil, mesh, global_shape, local_shape, axis_names,
+                counts, k, build_zslab_xwin_call, (27, 9), interpret,
+                periodic)
         if step is not None:
             return step
-        # z-slab builder declined (typically the VMEM window gate at very
-        # wide X): fall through to the padded kernel rather than turning a
-        # previously-working config into None
+        # both pad-free builders declined: fall through to the padded
+        # kernel rather than turning a previously-working config into None
     # (padfree requested but mesh shards y too: same padded fallback —
     # the clamp/slab trick needs whole y on every shard)
     # Periodic keeps frame identically False (no origins needed): wrap
@@ -349,13 +357,16 @@ def make_sharded_fused_step(
 
 
 def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
-                             axis_names, counts, k, build_call, interpret,
-                             periodic):
-    """shard_map wrapper for the z-slab pad-free fused kernel: width-m
+                             axis_names, counts, k, build_call, layout,
+                             interpret, periodic):
+    """shard_map wrapper for the z-slab pad-free fused kernels: width-m
     slab exchange (no concatenation, no padded copy), slabs handed to the
-    kernel as operands, frame from SMEM origin scalars."""
+    kernel as operands, frame from SMEM origin scalars.  ``layout`` is
+    (core views, slab views) per field — (9, 3) for the whole-row kernel,
+    (27, 9) for the wide-X variant."""
     from ..ops.pallas.fused import _halo_per_micro
 
+    n_core, n_slab = layout
     m = k * _halo_per_micro(stencil)
     built = build_call(stencil, local_shape,
                        tuple(int(g) for g in global_shape), k,
@@ -373,7 +384,7 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
         for f, bc in zip(fields, stencil.bc_value):
             lo, hi = exchange_slabs_axis(
                 f, 0, axis_names[0], counts[0], m, bc, periodic=periodic)
-            args += [f] * 9 + [lo] * 3 + [hi] * 3
+            args += [f] * n_core + [lo] * n_slab + [hi] * n_slab
         origins = jnp.array([
             lax.axis_index(axis_names[0]) * local_shape[0]
             if axis_names[0] else 0, 0], dtype=jnp.int32)

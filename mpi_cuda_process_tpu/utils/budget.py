@@ -92,6 +92,7 @@ def estimate_run_bytes(
         from ..ops.pallas.fused import (
             _halo_per_micro,
             build_zslab_padfree_call,
+            build_zslab_xwin_call,
             make_fused_step,
             prefer_padfree,
         )
@@ -108,9 +109,12 @@ def estimate_run_bytes(
         # Builder construction is pure Python — no compile happens here.
         if sharded and z_only and prefer_padfree(stencil, local,
                                                  batch=batch) \
-                and build_zslab_padfree_call(
+                and (build_zslab_padfree_call(
                     stencil, local, tuple(int(g) for g in grid), fuse,
-                    interpret=True, periodic=periodic) is not None:
+                    interpret=True, periodic=periodic) is not None
+                    or build_zslab_xwin_call(
+                        stencil, local, tuple(int(g) for g in grid), fuse,
+                        interpret=True, periodic=periodic) is not None):
             # z-slab pad-free (stepper._make_zslab_padfree_step): the
             # exchanged slabs are the ONLY transient — no padded copy
             slab_b = batch * 2 * m * ly * lx * itemsize * nfields

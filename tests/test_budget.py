@@ -118,18 +118,28 @@ def test_f32_at_4096_fits_on_z_only_mesh_padfree():
     assert any("pad-free" in label for label, _ in parts)
 
 
-def test_wave_zslab_untileable_falls_back_to_padded_estimate():
-    """Two-field wave3d cannot tile the z-slab window at X=4096 (VMEM
-    gate), so the budget must charge the PADDED path — a 'fits' row may
-    never describe an unconstructible execution (round-4 review)."""
+def test_config5_wave_f32_fits_via_wide_x_kernel():
+    """Two-field wave3d cannot tile the WHOLE-ROW z-slab window at X=4096
+    (VMEM gate), but the wide-X variant windows the lane axis and tiles —
+    so the budget charges slabs only and config 5 fits in FULL f32
+    (~14.3 GiB/device).  The chain is builder-verified: a 'fits' row
+    never describes an unconstructible execution (round-4 review)."""
     st = make_stencil("wave3d")
-    total, parts = budget.estimate_run_bytes(
-        st, (4096,) * 3, mesh=(64, 1, 1), fuse=4)
-    assert any("exchange-padded" in label for label, _ in parts)
-    assert total > V5E_HBM  # and it honestly does not fit in f32
-    with pytest.raises(ValueError):
-        budget.check_budget(st, (4096,) * 3, mesh=(64, 1, 1), fuse=4,
-                            hbm_bytes=V5E_HBM)
+    total, parts = budget.check_budget(
+        st, (4096,) * 3, mesh=(64, 1, 1), fuse=4, hbm_bytes=V5E_HBM)
+    assert any("pad-free" in label for label, _ in parts)
+    assert 13.5 * GiB < total < 15 * GiB
+
+
+def test_config5_wave_bf16_k8_wide_x_headroom():
+    """bf16 k=8 (margin 8, sublane-16-aligned) tiles wide-X too: config-5
+    wave in bf16 with temporal blocking is ~7.7 GiB/device — deep
+    headroom for larger tiles or deeper k once measured."""
+    st = make_stencil("wave3d", dtype="bfloat16")
+    total, parts = budget.check_budget(
+        st, (4096,) * 3, mesh=(64, 1, 1), fuse=8, hbm_bytes=V5E_HBM)
+    assert any("pad-free" in label for label, _ in parts)
+    assert total < 8.5 * GiB
 
 
 def test_2d_fuse_budget_counts_fullgrid_pad():

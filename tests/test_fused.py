@@ -360,3 +360,83 @@ def test_zslab_padfree_declines_y_sharded_mesh():
     step = make_sharded_fused_step(st, mesh, (32, 32, 128), 4,
                                    interpret=True, padfree=True)
     assert step is not None  # padded fallback
+
+
+# ---------------------------------------------------------------------------
+# wide-X z-slab kernel (x windowed at lane-tile granularity)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "name,grid,nz,k,kw",
+    [
+        ("heat3d", (32, 16, 256), 2, 4, {}),     # bx=128 < X=256: 2 x-tiles
+        ("wave3d", (32, 16, 256), 2, 4, {}),     # two-field, 90 operands
+        pytest.param("sor3d", (32, 16, 256), 2, 4, {},
+                     marks=pytest.mark.slow),    # parity incl. x offsets
+    ],
+)
+def test_xwin_zslab_matches_unsharded(name, grid, nz, k, kw):
+    from mpi_cuda_process_tpu import make_mesh, shard_fields
+    from mpi_cuda_process_tpu.ops.pallas import fused as F
+    from mpi_cuda_process_tpu.parallel import stepper as S
+
+    st = make_stencil(name, **kw)
+    fields = init_state(st, grid, seed=21, kind="pulse")
+    ref = fields
+    step = jax.jit(make_step(st, grid))
+    for _ in range(k):
+        ref = step(ref)
+    mesh = make_mesh((nz, 1, 1))
+    local = (grid[0] // nz, grid[1], grid[2])
+    axis_names, counts = S._resolve_mesh_axes(3, mesh)
+    fused = S._make_zslab_padfree_step(
+        st, mesh, grid, local, axis_names, counts, k,
+        lambda *a, **kw2: F.build_zslab_xwin_call(
+            *a, tiles=(8, 8, 128), **kw2),
+        (27, 9), True, False)
+    assert fused is not None
+    got = jax.jit(fused)(shard_fields(fields, mesh, 3))
+    for g, r in zip(got, ref):
+        assert jnp.allclose(g, r, rtol=0, atol=1e-4), name
+
+
+def test_xwin_zslab_periodic_matches_unsharded():
+    from mpi_cuda_process_tpu import make_mesh, shard_fields
+    from mpi_cuda_process_tpu.ops.pallas import fused as F
+    from mpi_cuda_process_tpu.parallel import stepper as S
+
+    st = make_stencil("heat3d")
+    grid = (32, 16, 256)
+    fields = init_state(st, grid, seed=22, kind="random", periodic=True)
+    ref = fields
+    step = jax.jit(make_step(st, grid, periodic=True))
+    for _ in range(4):
+        ref = step(ref)
+    mesh = make_mesh((2, 1, 1))
+    axis_names, counts = S._resolve_mesh_axes(3, mesh)
+    fused = S._make_zslab_padfree_step(
+        st, mesh, grid, (16, 16, 256), axis_names, counts, 4,
+        lambda *a, **kw2: F.build_zslab_xwin_call(
+            *a, tiles=(8, 8, 128), **kw2),
+        (27, 9), True, True)
+    assert fused is not None
+    got = jax.jit(fused)(shard_fields(fields, mesh, 3))
+    assert jnp.allclose(got[0], ref[0], rtol=0, atol=1e-4)
+
+
+def test_xwin_unlocks_wave_at_wide_x():
+    """The config-5 gap: wave3d at 4096 lanes is untileable for the
+    whole-row z-slab kernel but TILEABLE for the wide-X variant — and the
+    auto pad-free path reaches it through the builder chain."""
+    from mpi_cuda_process_tpu.ops.pallas.fused import (
+        build_zslab_padfree_call,
+        build_zslab_xwin_call,
+    )
+
+    st = make_stencil("wave3d")
+    local, gshape = (64, 4096, 4096), (4096, 4096, 4096)
+    assert build_zslab_padfree_call(st, local, gshape, 4,
+                                    interpret=True) is None
+    built = build_zslab_xwin_call(st, local, gshape, 4, interpret=True)
+    assert built is not None  # picks VMEM-feasible (bz, by, bx)
