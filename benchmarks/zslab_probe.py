@@ -34,7 +34,8 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # (label, stencil, dtype, local_shape, k, tiles)
-# ordered cheapest-question-first; heat3d rungs calibrate the estimate's
+# 2-tuple tiles -> whole-row z-slab kernel; 3-tuple -> wide-X variant.
+# Ordered cheapest-question-first; heat3d rungs calibrate the estimate's
 # accuracy against a config it PASSES, so a wave-only failure is
 # attributable to the second field rather than to the probe harness.
 ATTEMPTS = [
@@ -43,6 +44,14 @@ ATTEMPTS = [
     ("wave3d_f32_k4_t16", "wave3d", None, (64, 2048, 4096), 4, (16, 16)),
     ("wave3d_bf16_k8_t16", "wave3d", "bfloat16", (64, 2048, 4096), 8,
      (16, 16)),
+    # wide-X variants: the picker's actual choices for the config-5 local
+    # shapes — these measure the 4.5x-amplification kernel's REAL rate
+    ("wave3d_f32_k4_xwin", "wave3d", None, (64, 2048, 4096), 4,
+     (32, 16, 512)),
+    ("wave3d_bf16_k8_xwin", "wave3d", "bfloat16", (64, 2048, 4096), 8,
+     (16, 16, 256)),
+    ("heat3d_f32_k4_xwin", "heat3d", None, (64, 2048, 4096), 4,
+     (32, 32, 512)),
 ]
 
 _CHILD = """\
@@ -56,8 +65,15 @@ name, dt, local, k, tiles = {name!r}, {dt!r}, {local!r}, {k!r}, {tiles!r}
 kw = dict(dtype=jnp.bfloat16) if dt == "bfloat16" else {{}}
 st = make_stencil(name, **kw)
 gshape = (local[0] * 8, local[1], local[2])  # as if one of 8 z-shards
-built = build_zslab_padfree_call(st, local, gshape, k, tiles=tiles,
-                                 interpret=False)
+if len(tiles) == 3:
+    from mpi_cuda_process_tpu.ops.pallas.fused import build_zslab_xwin_call
+    built = build_zslab_xwin_call(st, local, gshape, k, tiles=tiles,
+                                  interpret=False)
+    n_core, n_slab = 27, 9
+else:
+    built = build_zslab_padfree_call(st, local, gshape, k, tiles=tiles,
+                                     interpret=False)
+    n_core, n_slab = 9, 3
 assert built is not None, "builder declined explicit tiles"
 call, m, nfields = built
 key = jax.random.PRNGKey(0)
@@ -67,7 +83,7 @@ slab = jnp.zeros((m, local[1], local[2]), st.dtype)
 origins = jnp.array([local[0], 0], jnp.int32)  # pretend shard 1 (interior)
 args = []
 for f in fields:
-    args += [f] * 9 + [slab] * 3 + [slab] * 3
+    args += [f] * n_core + [slab] * n_slab + [slab] * n_slab
 t0 = time.time()
 out = call(origins, *args)
 s = float(jnp.sum(out[0].astype(jnp.float32)))
