@@ -509,6 +509,17 @@ def _zslab_specs(Lz, Y, X, bz, by, m, periodic):
 _XWIN_GX = 128  # x-margin/granularity: one lane tile (>= any margin m)
 
 
+def _tiles_valid(Z, Y, bz, by, margin, itemsize) -> bool:
+    """Structural gates for EXPLICIT tiles — the same constraints the auto
+    pickers enforce.  A bz/by that is not a multiple of 2m degenerates
+    ``_tail_index_fns`` (r = 0) into silently-wrong window geometry
+    (found by the sor3d wide-X test: margin 8 with bz=8 tiles), so every
+    builder validates caller-supplied tiles through this."""
+    return not (bz % (2 * margin) or by % (2 * margin)
+                or Z % bz or Y % by
+                or (2 * margin) % _sublane(itemsize))
+
+
 def _pick_xwin_tiles(Lz, Y, X, margin, itemsize, nfields):
     """(bz, by, bx) for the wide-X kernel — the SAME sublane gate, VMEM
     cost model, and scoring as ``_pick_tiles`` (delegated there, so a
@@ -574,6 +585,9 @@ def build_zslab_xwin_call(
     bz, by, bx = tiles
     if bx >= X:
         return None  # whole-row windows: use the plain z-slab kernel
+    if not _tiles_valid(Lz, Y, bz, by, margin, itemsize) \
+            or X % bx or bx % _XWIN_GX:
+        return None
     micro = micro_factory(stencil, interpret)
     grid = (Lz // bz, Y // by, X // bx)
     core, slab = _xwin_specs(Lz, Y, X, bz, by, bx, margin, periodic)
@@ -720,6 +734,8 @@ def build_zslab_padfree_call(
     if tiles is None:
         return None
     bz, by = tiles
+    if not _tiles_valid(Lz, Y, bz, by, margin, itemsize):
+        return None
     micro = micro_factory(stencil, interpret)
     grid = (Lz // bz, Y // by)
     core, slab = _zslab_specs(Lz, Y, X, bz, by, margin, periodic)
@@ -879,6 +895,8 @@ def build_fused_call(
     if tiles is None:
         return None
     bz, by = tiles
+    if not _tiles_valid(Z, Y, bz, by, margin, itemsize):
+        return None
     micro = micro_factory(stencil, interpret)
 
     grid = (Z // bz, Y // by)

@@ -372,7 +372,10 @@ def test_zslab_padfree_declines_y_sharded_mesh():
     [
         ("heat3d", (32, 16, 256), 2, 4, {}),     # bx=128 < X=256: 2 x-tiles
         ("wave3d", (32, 16, 256), 2, 4, {}),     # two-field, 90 operands
-        pytest.param("sor3d", (32, 16, 256), 2, 4, {},
+        # sor margin is 8 (halo x 2 phases x k=4): tiles must be
+        # multiples of 16 — (8,8,128) correctly DECLINES now (see
+        # test_xwin_rejects_invalid_explicit_tiles)
+        pytest.param("sor3d", (32, 32, 256), 2, 4, {},
                      marks=pytest.mark.slow),    # parity incl. x offsets
     ],
 )
@@ -382,6 +385,10 @@ def test_xwin_zslab_matches_unsharded(name, grid, nz, k, kw):
     from mpi_cuda_process_tpu.parallel import stepper as S
 
     st = make_stencil(name, **kw)
+    # tiles must be multiples of 2*margin (margin doubles for the
+    # red-black 2-phase micro)
+    g2 = 2 * k * F._halo_per_micro(st)
+    tiles = (g2, g2, 128)
     fields = init_state(st, grid, seed=21, kind="pulse")
     ref = fields
     step = jax.jit(make_step(st, grid))
@@ -393,7 +400,7 @@ def test_xwin_zslab_matches_unsharded(name, grid, nz, k, kw):
     fused = S._make_zslab_padfree_step(
         st, mesh, grid, local, axis_names, counts, k,
         lambda *a, **kw2: F.build_zslab_xwin_call(
-            *a, tiles=(8, 8, 128), **kw2),
+            *a, tiles=tiles, **kw2),
         (27, 9), True, False)
     assert fused is not None
     got = jax.jit(fused)(shard_fields(fields, mesh, 3))
@@ -440,3 +447,21 @@ def test_xwin_unlocks_wave_at_wide_x():
                                     interpret=True) is None
     built = build_zslab_xwin_call(st, local, gshape, 4, interpret=True)
     assert built is not None  # picks VMEM-feasible (bz, by, bx)
+
+
+def test_xwin_rejects_invalid_explicit_tiles():
+    """Explicit tiles bypass the auto picker but NOT the structural
+    gates: a bz that is not a multiple of 2*margin degenerated
+    _tail_index_fns into silently-wrong geometry (the sor3d wide-X bug
+    this test pins)."""
+    from mpi_cuda_process_tpu.ops.pallas.fused import (
+        build_zslab_padfree_call,
+        build_zslab_xwin_call,
+    )
+
+    st = make_stencil("sor3d")  # margin 8 at k=4 (2 phases)
+    local, gshape = (16, 16, 256), (32, 16, 256)
+    assert build_zslab_xwin_call(st, local, gshape, 4, tiles=(8, 8, 128),
+                                 interpret=True) is None
+    assert build_zslab_padfree_call(st, local, gshape, 4, tiles=(8, 8),
+                                    interpret=True) is None
