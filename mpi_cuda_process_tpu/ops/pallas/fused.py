@@ -715,6 +715,12 @@ def build_zslab_padfree_call(
     raw LOCAL block + 3 views of the lower slab + 3 of the upper (pass
     the block 9x and each slab 3x), and returns ``nfields`` local-shape
     arrays advanced k steps.  Returns ``(call, margin, nfields)`` or None.
+
+    Reference lineage: the reference stored the FULL grid replicated on
+    every rank (kernel.cu:184-191) and exchanged one element per MPI
+    message (kernel.cu:228-230); here per-device storage is the shard
+    plus two width-m slabs, exchanged as whole ppermute transfers once
+    per k steps — the two memory/traffic limits inverted.
     """
     if not fused_supported(stencil):
         return None
