@@ -417,3 +417,16 @@ def test_baseline_config_analogues_run_end_to_end(label, args):
     arr = np.asarray(fields[0], dtype=np.float32)
     assert np.isfinite(arr).all(), label
     assert mcells > 0, label
+
+
+def test_tol_composes_with_sharded_fuse():
+    """Convergence mode + temporal blocking + decomposition in ONE run:
+    the while_loop body advances k fused steps on the sharded state."""
+    args = ["--stencil", "heat3d", "--grid", "16,16,128", "--iters", "40",
+            "--mesh", "2,1,1", "--fuse", "4", "--tol", "1e-7",
+            "--tol-check-every", "8"]
+    fields, _ = run(config_from_args(args))
+    arr = np.asarray(fields[0])
+    assert np.isfinite(arr).all()
+    # hot walls diffused inward: interior is strictly above the zero init
+    assert arr[1:-1, 1:-1, 1:-1].mean() > 0
