@@ -430,3 +430,28 @@ def test_tol_composes_with_sharded_fuse():
     assert np.isfinite(arr).all()
     # hot walls diffused inward: interior is strictly above the zero init
     assert arr[1:-1, 1:-1, 1:-1].mean() > 0
+
+
+def test_auto_fuse_at_1024_probes_padfree_variant(monkeypatch):
+    """At 1024^3 the auto-fuse probe must construct the PAD-FREE kernel
+    (the padded transient is the measured RESOURCE_EXHAUSTED) — pin that
+    maybe_auto_fuse upgrades, i.e. the probe chain doesn't decline."""
+    from mpi_cuda_process_tpu import cli
+    from mpi_cuda_process_tpu.ops.pallas import fused
+
+    monkeypatch.setattr(cli.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(fused, "_interpret_default", lambda: True)
+    built = {}
+    orig = fused.make_fused_step
+
+    def spy(st, grid, k, **kw):
+        built.setdefault("padfree", kw.get("padfree"))
+        return orig(st, grid, k, **kw)
+
+    monkeypatch.setattr(fused, "make_fused_step", spy)
+    # cli imported make_fused_step by name inside the function: patch the
+    # module it resolves from (it does a local import of fused each call)
+    cfg = RunConfig(stencil="heat3d", grid=(1024, 1024, 1024), iters=8)
+    out = cli.maybe_auto_fuse(cfg)
+    assert out.fuse == 4
+    assert built.get("padfree") is True  # the 1024^3 path, not the padded
