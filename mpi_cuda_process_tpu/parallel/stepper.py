@@ -15,7 +15,7 @@ divergence bugs (SURVEY.md §3.3) have no analogue here.
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence, Tuple
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp

@@ -11,7 +11,7 @@ C ``rand()`` with the default seed (SURVEY.md C8).
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
