@@ -36,12 +36,29 @@ def _fence(fields) -> float:
     return float(jnp.sum(fields[0].astype(jnp.float32)))
 
 
+def _parse_kspec(spec):
+    """``"4"`` -> (4, None); ``"4@16x16"`` -> (4, (16, 16)).
+
+    Explicit tiles are the compile-complexity hedge: the auto-picked
+    (64, 32) padfree window at 512^3 hung the Mosaic remote compile past
+    the subprocess budget (2026-07-31, heat3d_512_f32_padfree4), and the
+    kill wedged the tunnel — smaller explicit windows compile a strictly
+    smaller program for the same kernel class.
+    """
+    if "@" in spec:
+        k, t = spec.split("@", 1)
+        bz, by = t.split("x")
+        return int(k), (int(bz), int(by))
+    return int(spec), None
+
+
 def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
             params=None):
     """compute: jnp | pallas (compute_fn inside the pad step) |
     raw (whole-step raw kernel) | fusedK (3D windowed temporal blocking,
-    K steps/pass) | fullK (2D whole-grid-in-VMEM temporal blocking) |
-    copy (harness-calibration 1R+1W elementwise scan).
+    K steps/pass; ``fusedK@BZxBY`` pins explicit tiles) | fullK (2D
+    whole-grid-in-VMEM temporal blocking) | copy (harness-calibration
+    1R+1W elementwise scan).
     """
     kw = dict(params or {})
     if dtype is not None:
@@ -70,14 +87,15 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
     elif compute.startswith("padfree"):
         # pad-free 9-block raw-grid temporal blocking (no pad transient)
         from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
-        step_unit = int(compute[len("padfree"):])
-        step = make_fused_step(st, grid, step_unit, padfree=True)
+        step_unit, tiles = _parse_kspec(compute[len("padfree"):])
+        step = make_fused_step(st, grid, step_unit, tiles=tiles,
+                               padfree=True)
         if step is None:
             raise ValueError(f"untileable padfree k={step_unit} for {grid}")
     elif compute.startswith("fused"):
         from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
-        step_unit = int(compute[len("fused"):])
-        step = make_fused_step(st, grid, step_unit)
+        step_unit, tiles = _parse_kspec(compute[len("fused"):])
+        step = make_fused_step(st, grid, step_unit, tiles=tiles)
         if step is None:
             raise ValueError(f"untileable fused k={step_unit} for {grid}")
     elif compute.startswith("full"):
@@ -133,16 +151,25 @@ def _time_scan(step, mk, grid, steps, reps, step_unit):
 
 
 # (label, stencil, grid, steps, dtype, compute)
+#
+# ORDER IS EXECUTION ORDER, and it is risk-tiered (2026-07-31 lesson: the
+# auto-tiled heat3d_512_f32_padfree4 compile hung, its 1200 s kill wedged
+# the tunnel, and every label after it in file order was lost).  Tiers:
+#   A — round-3 measured successes (cache-skipped on rerun);
+#   B — safe pending: jnp references, calibration copies, fast structural
+#       declines, retries of fast-failing labels;
+#   C — 2D whole-grid VMEM kernels (new family on-chip, small programs);
+#   D — NEW large Mosaic compiles (padfree >=512, deep k, bf16 k=8):
+#       value-ordered, each gets the longer _RISKY budget, and a timeout
+#       is RECORDED so a rerun never re-wedges the tunnel on the same
+#       label (skip rule in main()).
 CONFIGS = [
-    # BASELINE.json config 1 + 2 refresh
+    # ── Tier A: BASELINE refresh + round-3 measured table ──
     ("heat2d_512_f32", "heat2d", (512, 512), 400, "float32", "jnp"),
     ("heat3d_256_f32", "heat3d", (256, 256, 256), 100, "float32", "jnp"),
-    # bf16 halves HBM bytes (STATE.md open avenue 2)
     ("heat3d_256_bf16", "heat3d", (256, 256, 256), 100, "bfloat16", "jnp"),
-    # larger grid: the round-2 XLA fusion cliff regime
     ("heat3d_512_f32", "heat3d", (512, 512, 512), 30, "float32", "jnp"),
     ("heat3d_512_bf16", "heat3d", (512, 512, 512), 30, "bfloat16", "jnp"),
-    # whole-step raw Pallas kernels (round 3; ops/pallas/rawstep.py)
     ("heat3d_256_f32_raw", "heat3d", (256, 256, 256), 100, "float32", "raw"),
     ("heat3d_512_f32_raw", "heat3d", (512, 512, 512), 30, "float32", "raw"),
     ("heat3d27_256_f32_raw", "heat3d27", (256, 256, 256), 50, "float32",
@@ -153,43 +180,12 @@ CONFIGS = [
      "raw"),
     ("wave3d_256_f32_raw", "wave3d", (256, 256, 256), 50, "float32", "raw"),
     ("wave3d_512_f32_raw", "wave3d", (512, 512, 512), 20, "float32", "raw"),
-    # temporal blocking: k real steps per HBM pass (ops/pallas/fused.py);
-    # the CLI's auto path for heat3d
     ("heat3d_256_f32_fused4", "heat3d", (256, 256, 256), 25, "float32",
      "fused4"),
     ("heat3d_512_f32_fused4", "heat3d", (512, 512, 512), 10, "float32",
      "fused4"),
-    # pad-free 9-block kernel (round 4): same k, no pad transient — does
-    # dropping the pad's ~2 HBM passes beat the extra window redundancy?
     ("heat3d_256_f32_padfree4", "heat3d", (256, 256, 256), 25, "float32",
      "padfree4"),
-    ("heat3d_512_f32_padfree4", "heat3d", (512, 512, 512), 10, "float32",
-     "padfree4"),
-    # deeper temporal blocking (fori_loop lowering): k=8/16 multiply the
-    # per-pass amortization — the VERDICT-5 ceiling probe
-    ("heat3d_512_f32_fused8", "heat3d", (512, 512, 512), 6, "float32",
-     "fused8"),
-    ("heat3d_512_f32_padfree8", "heat3d", (512, 512, 512), 6, "float32",
-     "padfree8"),
-    ("heat3d_512_f32_fused16", "heat3d", (512, 512, 512), 3, "float32",
-     "fused16"),
-    ("heat3d_512_bf16_fused4", "heat3d", (512, 512, 512), 10, "bfloat16",
-     "fused4"),
-    # bf16 temporal blocking needs k=8 (sublane 16); padfree variant too
-    ("heat3d_256_bf16_padfree8", "heat3d", (256, 256, 256), 13, "bfloat16",
-     "padfree8"),
-    ("heat3d_512_bf16_padfree8", "heat3d", (512, 512, 512), 6, "bfloat16",
-     "padfree8"),
-    # bf16 needs k=8: tail-block sublane alignment is 16 for 2-byte dtypes
-    # (fused._sublane) — k=4's 8-row tails were the round-3 bf16 compile
-    # failure; k=4 now correctly reports untileable.  BUT k=8 bf16 HANGS
-    # the Mosaic compile even when aligned (heat3d_256_bf16_fused8 hit the
-    # 1200 s subprocess budget on 2026-07-30; the kill risks wedging the
-    # tunnel) — so bf16 temporal blocking stays OFF the campaign until the
-    # compile hang is bisected (smaller tiles / shallower unroll).
-    # ("heat3d_256_bf16_fused8", "heat3d", (256, 256, 256), 13, "bfloat16",
-    #  "fused8"),
-    # fused families (round 3: generalized to 27-point, halo-2, two-field)
     ("heat3d27_256_f32_fused4", "heat3d27", (256, 256, 256), 15, "float32",
      "fused4"),
     ("heat3d27_512_f32_fused4", "heat3d27", (512, 512, 512), 8, "float32",
@@ -200,82 +196,78 @@ CONFIGS = [
      "fused4"),
     ("wave3d_512_f32_fused4", "wave3d", (512, 512, 512), 8, "float32",
      "fused4"),
-    ("wave3d_512_f32_padfree4", "wave3d", (512, 512, 512), 8, "float32",
-     "padfree4"),
-    ("heat3d27_512_f32_padfree4", "heat3d27", (512, 512, 512), 8, "float32",
-     "padfree4"),
-    # 1024^3: the largest single-chip grids (bf16 2.1 GiB / f32 4.3 GiB per
-    # buffer — the closest single-chip proxy for the 4096^3 north star);
-    # jnp vs raw vs fused
-    # the pad-free kernel is the designed 1024^3 path: two state buffers
-    # only (8.6 GiB f32 / 4.3 GiB bf16), no pad transient
-    ("heat3d_1024_f32_padfree4", "heat3d", (1024, 1024, 1024), 4, "float32",
-     "padfree4"),
-    ("heat3d_1024_bf16_padfree8", "heat3d", (1024, 1024, 1024), 4,
-     "bfloat16", "padfree8"),
-    ("heat3d_1024_bf16", "heat3d", (1024, 1024, 1024), 8, "bfloat16", "jnp"),
-    ("heat3d_1024_bf16_raw", "heat3d", (1024, 1024, 1024), 8, "bfloat16",
-     "raw"),
-    ("heat3d_1024_bf16_fused4", "heat3d", (1024, 1024, 1024), 4, "bfloat16",
-     "fused4"),
-    ("heat3d_1024_f32_raw", "heat3d", (1024, 1024, 1024), 6, "float32",
-     "raw"),
-    ("heat3d_1024_f32_fused4", "heat3d", (1024, 1024, 1024), 4, "float32",
-     "fused4"),
-    # transport + reaction families: raw kernel vs jnp
-    # harness calibration: pure 1R+1W elementwise scan (GB/s anchor)
-    ("copy_256_f32", None, (256, 256, 256), 100, "float32", "copy"),
-    ("copy_512_f32", None, (512, 512, 512), 30, "float32", "copy"),
     ("advect3d_256_f32_jnp", "advect3d", (256, 256, 256), 50, "float32",
      "jnp"),
-    # cross-check at a different scan length: the 150 Gcells/s reading
-    # implies >1.2 TB/s effective HBM traffic (1R+1W at 4B) — above v5e's
-    # physical peak; verify it isn't an N-vs-4N differencing artifact
-    ("advect3d_256_f32_jnp_n150", "advect3d", (256, 256, 256), 150,
-     "float32", "jnp"),
-    ("advect3d_512_f32_jnp", "advect3d", (512, 512, 512), 15, "float32",
-     "jnp"),
-    ("advect3d_256_f32_fused4", "advect3d", (256, 256, 256), 13, "float32",
-     "fused4"),
-    ("advect3d_512_f32_fused4", "advect3d", (512, 512, 512), 6, "float32",
-     "fused4"),
     ("advect3d_256_f32_raw", "advect3d", (256, 256, 256), 50, "float32",
      "raw"),
     ("grayscott3d_256_f32_jnp", "grayscott3d", (256, 256, 256), 30,
      "float32", "jnp"),
     ("grayscott3d_256_f32_raw", "grayscott3d", (256, 256, 256), 30,
      "float32", "raw"),
-    ("grayscott3d_256_f32_fused4", "grayscott3d", (256, 256, 256), 10,
-     "float32", "fused4"),
-    ("grayscott3d_512_f32_fused4", "grayscott3d", (512, 512, 512), 5,
-     "float32", "fused4"),
-    # jnp references for the 27-point / 13-point / wave families
-    ("heat3d27_256_f32_jnp", "heat3d27", (256, 256, 256), 50, "float32", "jnp"),
+    ("heat3d27_256_f32_jnp", "heat3d27", (256, 256, 256), 50, "float32",
+     "jnp"),
     ("heat3d4th_256_f32_jnp", "heat3d4th", (256, 256, 256), 50, "float32",
      "jnp"),
     ("heat3d27_256_bf16_jnp", "heat3d27", (256, 256, 256), 50, "bfloat16",
      "jnp"),
-    # large-grid jnp references for the 27-point / 4th-order families (the
-    # cliff regime: does XLA's fusion collapse like heat3d's 86->17.6?)
+    ("wave3d_256_f32", "wave3d", (256, 256, 256), 50, "float32", "jnp"),
+    ("wave3d_256_bf16", "wave3d", (256, 256, 256), 50, "bfloat16", "jnp"),
+    ("wave3d_512_bf16", "wave3d", (512, 512, 512), 20, "bfloat16", "jnp"),
+    ("life_2048_i32", "life", (2048, 2048), 200, None, "jnp"),
+    ("heat3d_256_f32_pallas", "heat3d", (256, 256, 256), 100, "float32",
+     "pallas"),
+    # ── Tier B: safe pending — no new Mosaic compile classes ──
+    # harness calibration: pure 1R+1W elementwise scan (GB/s anchor)
+    ("copy_256_f32", None, (256, 256, 256), 100, "float32", "copy"),
+    ("copy_512_f32", None, (512, 512, 512), 30, "float32", "copy"),
+    # advect3d 150 Gcells/s suspect resolution: different scan length +
+    # larger grid (>1.2 TB/s implied traffic exceeds v5e HBM peak)
+    ("advect3d_256_f32_jnp_n150", "advect3d", (256, 256, 256), 150,
+     "float32", "jnp"),
+    ("advect3d_512_f32_jnp", "advect3d", (512, 512, 512), 15, "float32",
+     "jnp"),
+    # large-grid jnp references (the cliff regime: does XLA's fusion
+    # collapse like heat3d's 86->17.6?)
     ("heat3d27_512_f32_jnp", "heat3d27", (512, 512, 512), 15, "float32",
      "jnp"),
     ("heat3d4th_512_f32_jnp", "heat3d4th", (512, 512, 512), 15, "float32",
      "jnp"),
-    ("heat3d4th_512_f32_fused2", "heat3d4th", (512, 512, 512), 8, "float32",
-     "fused2"),
-    # halo-2 at k=2 only amortizes 2 steps/pass; k=4 (margin 8) trades more
-    # overlap redundancy for 2x the amortization
+    ("sor2d_1024_f32_jnp", "sor2d", (1024, 1024), 100, "float32", "jnp"),
+    ("sor3d_256_f32_jnp", "sor3d", (256, 256, 256), 30, "float32", "jnp"),
+    # 1024^3 jnp/raw retries: r03 failures were FAST errors (OOM / HTTP
+    # 500), not hangs; full head+tail stderr is captured this round
+    ("heat3d_1024_bf16", "heat3d", (1024, 1024, 1024), 8, "bfloat16", "jnp"),
+    ("heat3d_1024_bf16_raw", "heat3d", (1024, 1024, 1024), 8, "bfloat16",
+     "raw"),
+    ("heat3d_1024_f32_raw", "heat3d", (1024, 1024, 1024), 6, "float32",
+     "raw"),
+    # pure-Python structural declines (sublane misalignment) — instant
+    ("heat3d_512_bf16_fused4", "heat3d", (512, 512, 512), 10, "bfloat16",
+     "fused4"),
+    ("heat3d_1024_bf16_fused4", "heat3d", (1024, 1024, 1024), 4, "bfloat16",
+     "fused4"),
+    # padded-fused-class compiles: the same builder/lowering measured on
+    # chip at 256^3 AND 512^3 in round 3 (heat3d/heat3d27/wave3d fused4)
+    ("advect3d_256_f32_fused4", "advect3d", (256, 256, 256), 13, "float32",
+     "fused4"),
+    ("advect3d_512_f32_fused4", "advect3d", (512, 512, 512), 6, "float32",
+     "fused4"),
+    ("grayscott3d_256_f32_fused4", "grayscott3d", (256, 256, 256), 10,
+     "float32", "fused4"),
+    ("grayscott3d_512_f32_fused4", "grayscott3d", (512, 512, 512), 5,
+     "float32", "fused4"),
+    ("sor3d_256_f32_fused4", "sor3d", (256, 256, 256), 10, "float32",
+     "fused4"),
     ("heat3d4th_256_f32_fused4", "heat3d4th", (256, 256, 256), 12, "float32",
      "fused4"),
-    # two-field wave (BASELINE config 5 family), fp32 vs bf16
-    ("wave3d_256_f32", "wave3d", (256, 256, 256), 50, "float32", "jnp"),
-    ("wave3d_256_bf16", "wave3d", (256, 256, 256), 50, "bfloat16", "jnp"),
-    ("wave3d_512_bf16", "wave3d", (512, 512, 512), 20, "bfloat16", "jnp"),
-    # int32 GoL throughput (bit-exact family)
-    ("life_2048_i32", "life", (2048, 2048), 200, None, "jnp"),
-    # whole-grid VMEM temporal blocking: 2D state fits VMEM entirely, so k
-    # steps cost ONE HBM round-trip (ops/pallas/fullgrid.py); k=16/32 are
-    # compute-bound probes of the VPU ceiling
+    ("heat3d4th_512_f32_fused2", "heat3d4th", (512, 512, 512), 8, "float32",
+     "fused2"),
+    # padded fused at 1024^3 f32: expected RESOURCE_EXHAUSTED (3x4.3 GiB
+    # transient) — a fast allocation error, recorded for the table
+    ("heat3d_1024_f32_fused4", "heat3d", (1024, 1024, 1024), 4, "float32",
+     "fused4"),
+    # ── Tier C: 2D whole-grid VMEM kernels (new family; small programs —
+    # the whole grid is one VMEM block, no window assembly) ──
     ("life_2048_i32_full16", "life", (2048, 2048), 30, None, "full16"),
     ("life_1024_i32_full32", "life", (1024, 1024), 30, None, "full32"),
     ("heat2d_512_f32_full32", "heat2d", (512, 512), 40, "float32", "full32"),
@@ -285,25 +277,59 @@ CONFIGS = [
      "full16"),
     ("grayscott2d_1024_f32_full16", "grayscott2d", (1024, 1024), 15,
      "float32", "full16"),
-    ("sor2d_1024_f32_jnp", "sor2d", (1024, 1024), 100, "float32", "jnp"),
     ("sor2d_1024_f32_full16", "sor2d", (1024, 1024), 15, "float32",
      "full16"),
-    # 3D red-black SOR: 2 half-sweeps/step (phase-aware fused margins)
-    ("sor3d_256_f32_jnp", "sor3d", (256, 256, 256), 30, "float32", "jnp"),
-    ("sor3d_256_f32_fused4", "sor3d", (256, 256, 256), 10, "float32",
-     "fused4"),
-    # compute_fn z-chunk kernel inside the pad step (M1 kernel, for the
-    # record: measured below both jnp and raw — kept as the regression probe
-    # for the pad-based pallas integration)
-    ("heat3d_256_f32_pallas", "heat3d", (256, 256, 256), 100, "float32",
-     "pallas"),
-    # LAST on purpose: bf16 k=8 (sublane-16 alignment) hung its unrolled
-    # Mosaic compile; k>4 now lowers as a fori_loop (constant program
-    # size).  If this still hangs it costs one 1200 s subprocess at the
-    # very end of the campaign, nothing else.
+    # ── Tier D: NEW large Mosaic compiles — value-ordered, _RISKY budget,
+    # timeouts recorded.  A hang near the top must not cost the numbers
+    # below it on a rerun (recorded timeouts are skipped). ──
+    # D1: THE gateway number (VERDICT missing #2) — 1024^3 f32 via the
+    # pad-free kernel; explicit (16,16) tiles first (smallest window =
+    # smallest Mosaic program; the auto pick (32,32) follows)
+    ("heat3d_1024_f32_padfree4_t16", "heat3d", (1024, 1024, 1024), 4,
+     "float32", "padfree4@16x16"),
+    ("heat3d_1024_f32_padfree4", "heat3d", (1024, 1024, 1024), 4, "float32",
+     "padfree4"),
+    # D2: the deep-k ceiling probe (VERDICT #5) — padded class, proven at
+    # 512^3 k=4; k=8 doubles per-pass amortization via the fori_loop body
+    ("heat3d_512_f32_fused8", "heat3d", (512, 512, 512), 6, "float32",
+     "fused8"),
+    # D3: the bf16 story (VERDICT #3) at the proven-compile size
+    ("heat3d_256_bf16_padfree8", "heat3d", (256, 256, 256), 13, "bfloat16",
+     "padfree8"),
     ("heat3d_256_bf16_fused8", "heat3d", (256, 256, 256), 13, "bfloat16",
      "fused8"),
+    ("heat3d_1024_bf16_padfree8", "heat3d", (1024, 1024, 1024), 4,
+     "bfloat16", "padfree8"),
+    # D4: padfree generality at 512^3 (wave/27-point) + the explicit-tile
+    # hedge for the label whose auto-tiled compile hung on 2026-07-31
+    ("wave3d_512_f32_padfree4", "wave3d", (512, 512, 512), 8, "float32",
+     "padfree4"),
+    ("heat3d27_512_f32_padfree4", "heat3d27", (512, 512, 512), 8, "float32",
+     "padfree4"),
+    ("heat3d_512_f32_padfree4_t16", "heat3d", (512, 512, 512), 10,
+     "float32", "padfree4@16x16"),
+    ("heat3d_512_f32_padfree4", "heat3d", (512, 512, 512), 10, "float32",
+     "padfree4"),
+    # D5: deeper ceiling probes
+    ("heat3d_512_f32_padfree8", "heat3d", (512, 512, 512), 6, "float32",
+     "padfree8"),
+    ("heat3d_512_bf16_padfree8", "heat3d", (512, 512, 512), 6, "bfloat16",
+     "padfree8"),
+    ("heat3d_512_f32_fused16", "heat3d", (512, 512, 512), 3, "float32",
+     "fused16"),
 ]
+
+# Tier-D labels: new large Mosaic compiles.  A hang here is plausibly a
+# SLOW compile (the round-3 bf16 k=8 unrolled compile exceeded 20 min);
+# killing a live remote compile is what wedges the tunnel, so these get a
+# longer leash before the kill.  Derived from CONFIGS order — everything
+# at/after the first Tier-D row is risky, so a new Tier-D label can't
+# silently get the short budget.
+_RISKY_BUDGET_S = 2400
+_TIER_D_START = "heat3d_1024_f32_padfree4_t16"
+_RISKY = frozenset(
+    label for label, *_ in
+    CONFIGS[[label for label, *_ in CONFIGS].index(_TIER_D_START):])
 
 
 # Bumped whenever kernel-builder code changes in a way that can turn a
@@ -312,6 +338,29 @@ CONFIGS = [
 # builder are retried instead of skipped — tileability is a property of the
 # CODE, not the config (round-3 advisor finding).
 BUILDER_REV = 4
+
+
+def _read_results(out_path):
+    if os.path.exists(out_path):
+        with open(out_path) as fh:
+            return json.load(fh)
+    return {}
+
+
+def _write_results(out_path, results):
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(results, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, out_path)
+
+
+def _merge_record(out_path, label, rec):
+    """Atomically merge one label's record into the results file."""
+    results = _read_results(out_path)
+    results[label] = rec
+    _write_results(out_path, results)
+    print(f"[measure] {label}: {rec}", file=sys.stderr)
 
 
 def _measure_one(out_path, label, name, grid, steps, dtype, compute):
@@ -332,17 +381,27 @@ def _measure_one(out_path, label, name, grid, steps, dtype, compute):
                 "builder_rev": BUILDER_REV,
                 "wall_s": round(time.time() - t0, 1),
                 "measured_at": time.time()})
-    results = {}
-    if os.path.exists(out_path):
-        with open(out_path) as fh:
-            results = json.load(fh)
-    results[label] = rec
-    tmp = out_path + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(results, fh, indent=1, sort_keys=True)
-        fh.write("\n")
-    os.replace(tmp, out_path)
-    print(f"[measure] {label}: {rec}", file=sys.stderr)
+    _merge_record(out_path, label, rec)
+
+
+def _tunnel_probe_ok(timeout_s=180):
+    """Run a trivial op in a subprocess: True iff the backend answers.
+
+    Gates the campaign so no label ever starts against a wedged tunnel —
+    a label that times out on a healthy tunnel is genuine evidence about
+    its own compile, never confounded by a pre-existing wedge.
+    """
+    import subprocess
+
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; "
+             "print(float(jnp.ones((8, 8)).sum()))"],
+            timeout=timeout_s, capture_output=True)
+        return p.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
 
 def main():
@@ -374,62 +433,120 @@ def main():
 
             shutil.copy(prev, args.out)
 
-    results = {}
-    if os.path.exists(args.out):
-        with open(args.out) as fh:
-            results = json.load(fh)
+    results = _read_results(args.out)
+
+    # Probe whenever child processes will be spawned — INCLUDING --only
+    # (the documented retry path for recorded timeouts): a retry against a
+    # still-wedged tunnel would time out and blame an innocent compile.
+    subprocess_mode = not args.in_process
+    if subprocess_mode and not _tunnel_probe_ok():
+        print("[measure] tunnel probe failed — backend wedged or "
+              "unreachable; aborting before any label (rerun to resume)",
+              file=sys.stderr)
+        return
 
     consecutive_timeouts = 0
     for label, name, grid, steps, dtype, compute in CONFIGS:
         if args.only and label not in args.only:
             continue
         cached = results.get(label)
-        # Skip successes AND deterministic structural declines ("untileable"
-        # is a pure-Python ValueError, identical on every run) — only
-        # transient failures (tunnel/RPC/OOM) are retried.  An untileable
-        # decline recorded by an OLDER builder revision is retried too:
-        # kernel-builder changes (new lowerings, relaxed alignment gates)
-        # can make it tileable (round-3 advisor finding).
+        # Skip successes AND deterministic-at-this-builder-rev failures:
+        #  - "untileable" structural declines (pure-Python ValueError,
+        #    identical on every run);
+        #  - recorded subprocess TIMEOUTS (presumed Mosaic compile hangs):
+        #    retrying one re-kills a live remote compile, which is exactly
+        #    what wedges the tunnel (2026-07-31) — retry only via --only
+        #    or a BUILDER_REV bump after a builder change.
+        # Transient failures (tunnel/RPC/OOM) are retried.
         if cached and not args.only and (
                 "error" not in cached
-                or ("untileable" in cached.get("error", "")
+                or (("untileable" in cached.get("error", "")
+                     or cached.get("timeout"))
                     and cached.get("builder_rev") == BUILDER_REV)):
             print(f"[measure] {label}: cached, skip", file=sys.stderr)
             continue
-        if args.in_process or args.only:
+        if args.in_process:
             _measure_one(args.out, label, name, grid, steps, dtype, compute)
         else:
+            # Subprocess + budget even under --only: the documented retry
+            # path for recorded timeouts must not reintroduce an unbounded
+            # in-session hang (the operator's manual kill of a live remote
+            # compile is exactly what wedges the tunnel).
             # Subprocess isolation: a RESOURCE_EXHAUSTED on one config must
             # not leave the TPU arena poisoned for every config after it
             # (observed in the round-3 campaign: a 1024^3 OOM turned the
             # rest of the matrix into cascade failures).
             import subprocess
 
+            budget = _RISKY_BUDGET_S if label in _RISKY else 1200
+            pre_rec = results.get(label)  # snapshot before the spawn
             try:
                 p = subprocess.run(
                     [sys.executable, os.path.abspath(__file__),
-                     "--only", label, "--out", os.path.abspath(args.out)],
+                     "--only", label, "--in-process",
+                     "--out", os.path.abspath(args.out)],
                     cwd=os.path.dirname(
                         os.path.dirname(os.path.abspath(__file__))),
-                    timeout=1200,
+                    timeout=budget,
                 )
                 if p.returncode != 0:
                     print(f"[measure] {label}: subprocess rc={p.returncode}",
                           file=sys.stderr)
                 consecutive_timeouts = 0
             except subprocess.TimeoutExpired:
-                # a wedged config must cost only itself, not the campaign
-                print(f"[measure] {label}: subprocess timeout (1200s), "
+                # A hung config must cost only itself, not the campaign —
+                # and must not be silently retried by the next run (the
+                # retry would hang and be killed again, re-wedging the
+                # tunnel), so the timeout is recorded like a decline.
+                # UNLESS the killed child already merged a record (success
+                # OR a real error diagnosis, e.g. a fast OOM followed by a
+                # teardown hang) before the kill: never clobber what the
+                # child actually learned.
+                print(f"[measure] {label}: subprocess timeout ({budget}s), "
                       "skipping", file=sys.stderr)
+                # Probe BEFORE recording: a healthy post-kill probe means
+                # the hang was genuinely this label's compile; a failed
+                # probe is ambiguous (its own kill wedged the tunnel, OR
+                # the tunnel wedged mid-campaign before the label started)
+                # and the record must say so.
+                tunnel_ok = _tunnel_probe_ok()
+                child_rec = _read_results(args.out).get(label)
+                if child_rec == pre_rec:
+                    msg = (f"subprocess timeout ({budget}s) — presumed "
+                           "Mosaic compile hang; the kill may wedge the "
+                           "tunnel.  Not auto-retried: rerun with --only "
+                           "after a builder change.")
+                    if not tunnel_ok:
+                        msg += ("  SUSPECT: the post-kill tunnel probe "
+                                "failed, so the tunnel may already have "
+                                "been wedged before this label started — "
+                                "the hang may not be this compile's "
+                                "fault.")
+                    rec = {"error": msg, "timeout": True, "stencil": name,
+                           "grid": list(grid), "dtype": dtype,
+                           "compute": compute, "builder_rev": BUILDER_REV,
+                           "wall_s": float(budget),
+                           "measured_at": time.time()}
+                    if not tunnel_ok:
+                        rec["suspect"] = True
+                    _merge_record(args.out, label, rec)
+                if not tunnel_ok:
+                    # don't let the next label run into a wedged tunnel (a
+                    # wedged-tunnel timeout would blame an innocent compile)
+                    print("[measure] tunnel probe failed after the kill — "
+                          "wedged; aborting campaign (rerun to resume)",
+                          file=sys.stderr)
+                    break
                 consecutive_timeouts += 1
                 if consecutive_timeouts >= 2:
-                    # Two configs in a row hanging = the tunnel itself is
-                    # wedged (recovery is passive and takes hours —
-                    # docs/STATE.md); paying 1200s per remaining config
-                    # would burn the whole campaign for nothing.
-                    print("[measure] 2 consecutive timeouts — tunnel looks "
-                          "wedged, aborting campaign (rerun to resume)",
-                          file=sys.stderr)
+                    # Backstop for wedge modes the trivial-op probe can't
+                    # see (e.g. only the remote-compile service hung):
+                    # two full-budget burns in a row with a "healthy"
+                    # probe means something systemic — stop paying the
+                    # budget per remaining label.
+                    print("[measure] 2 consecutive timeouts despite "
+                          "healthy probes — systemic; aborting campaign "
+                          "(rerun to resume)", file=sys.stderr)
                     break
 
     if not args.only and os.path.exists(args.out):
