@@ -1,0 +1,129 @@
+"""Campaign-hardening logic in benchmarks/measure.py.
+
+A regression in any of these rules costs real hardware time: a retried
+compile hang re-kills a live Mosaic remote compile, which wedges the TPU
+tunnel for hours (observed 2026-07-30 and 2026-07-31 — docs/STATE.md).
+Everything here is pure-Python / CPU-backend; no label is measured on TPU.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_BENCH_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks")
+
+
+@pytest.fixture()
+def M():
+    """A fresh measure module (CONFIGS edits must not leak across tests)."""
+    spec = importlib.util.spec_from_file_location(
+        "measure_under_test", os.path.join(_BENCH_DIR, "measure.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_parse_kspec(M):
+    assert M._parse_kspec("4") == (4, None)
+    assert M._parse_kspec("16") == (16, None)
+    assert M._parse_kspec("4@16x16") == (4, (16, 16))
+    assert M._parse_kspec("8@32x16") == (8, (32, 16))
+
+
+def test_labels_unique_and_risky_derived(M):
+    labels = [label for label, *_ in M.CONFIGS]
+    assert len(labels) == len(set(labels))
+    # the risky set is positional: everything at/after the Tier-D marker
+    start = labels.index(M._TIER_D_START)
+    assert M._RISKY == frozenset(labels[start:])
+    # Tier D must be non-empty and must not swallow the safe tiers
+    assert 0 < len(M._RISKY) < len(labels) / 2
+
+
+def test_risky_labels_are_new_large_compiles(M):
+    # every risky label is a fused/padfree variant (the only classes that
+    # have ever hung the Mosaic compile); jnp/raw/copy/full never hang
+    for label, name, grid, steps, dtype, compute in M.CONFIGS:
+        if label in M._RISKY:
+            assert compute.startswith(("fused", "padfree")), label
+
+
+def _run_single_label(M, out, label="heat2d_512_f32"):
+    M.CONFIGS = [c for c in M.CONFIGS if c[0] == label]
+    argv = sys.argv
+    sys.argv = ["measure.py", "--out", out, "--in-process"]
+    try:
+        M.main()
+    finally:
+        sys.argv = argv
+
+
+def test_recorded_timeout_skipped_at_current_rev(M, tmp_path):
+    out = str(tmp_path / "r.json")
+    rec = {"error": "subprocess timeout (2400s)", "timeout": True,
+           "builder_rev": M.BUILDER_REV}
+    (tmp_path / "r.json").write_text(json.dumps({"heat2d_512_f32": rec}))
+    _run_single_label(M, out)
+    assert json.loads((tmp_path / "r.json").read_text())[
+        "heat2d_512_f32"] == rec  # untouched: skipped, not re-measured
+
+
+def test_recorded_timeout_retried_after_builder_bump(M, tmp_path):
+    out = str(tmp_path / "r.json")
+    (tmp_path / "r.json").write_text(json.dumps({"heat2d_512_f32": {
+        "error": "subprocess timeout (2400s)", "timeout": True,
+        "builder_rev": M.BUILDER_REV - 1}}))
+    _run_single_label(M, out)
+    got = json.loads((tmp_path / "r.json").read_text())["heat2d_512_f32"]
+    assert "mcells_per_s" in got  # re-measured under the newer builder
+
+
+def test_transient_error_still_retried(M, tmp_path):
+    out = str(tmp_path / "r.json")
+    (tmp_path / "r.json").write_text(json.dumps({"heat2d_512_f32": {
+        "error": "RESOURCE_EXHAUSTED: ...", "builder_rev": M.BUILDER_REV}}))
+    _run_single_label(M, out)
+    got = json.loads((tmp_path / "r.json").read_text())["heat2d_512_f32"]
+    assert "mcells_per_s" in got
+
+
+def test_untileable_decline_skipped_at_current_rev(M, tmp_path):
+    out = str(tmp_path / "r.json")
+    rec = {"error": "ValueError: untileable fused k=4 for (512, 512, 512)",
+           "builder_rev": M.BUILDER_REV}
+    (tmp_path / "r.json").write_text(json.dumps({"heat2d_512_f32": rec}))
+    _run_single_label(M, out)
+    assert json.loads((tmp_path / "r.json").read_text())[
+        "heat2d_512_f32"] == rec
+
+
+def test_merge_record_preserves_other_labels(M, tmp_path):
+    out = str(tmp_path / "r.json")
+    (tmp_path / "r.json").write_text(json.dumps({"other": {"x": 1}}))
+    M._merge_record(out, "new", {"y": 2})
+    got = json.loads((tmp_path / "r.json").read_text())
+    assert got == {"other": {"x": 1}, "new": {"y": 2}}
+
+
+def test_explicit_tile_labels_construct(M):
+    """The @BZxBY hedge labels must build a real kernel (interpret mode):
+    a typo'd tile pair would otherwise surface only on the real chip."""
+    from mpi_cuda_process_tpu import make_stencil
+    from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
+
+    for label, name, grid, steps, dtype, compute in M.CONFIGS:
+        if "@" not in compute:
+            continue
+        k, tiles = M._parse_kspec(
+            compute[len("padfree" if compute.startswith("padfree")
+                        else "fused"):])
+        # tiles must divide a shard-sized proxy of the grid and pass the
+        # builder's own validation on the REAL grid shape
+        st = make_stencil(name, dtype=dtype) if dtype else make_stencil(name)
+        step = make_fused_step(st, grid, k, tiles=tiles,
+                               padfree=compute.startswith("padfree"))
+        assert step is not None, f"{label}: hedge tiles rejected"
