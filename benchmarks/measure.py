@@ -458,10 +458,15 @@ def main():
         #    what wedges the tunnel (2026-07-31) — retry only via --only
         #    or a BUILDER_REV bump after a builder change.
         # Transient failures (tunnel/RPC/OOM) are retried.
+        # (a suspect timeout — post-kill probe failed, so the hang may not
+        # have been this label's fault — is treated as transient and
+        # retried; the start-of-run probe guarantees the retry only ever
+        # happens against a healthy tunnel)
         if cached and not args.only and (
                 "error" not in cached
                 or (("untileable" in cached.get("error", "")
-                     or cached.get("timeout"))
+                     or (cached.get("timeout")
+                         and not cached.get("suspect")))
                     and cached.get("builder_rev") == BUILDER_REV)):
             print(f"[measure] {label}: cached, skip", file=sys.stderr)
             continue

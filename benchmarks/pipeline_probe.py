@@ -16,6 +16,13 @@ Probes (each its own label; run on a HEALTHY, otherwise-idle tunnel):
   manual2_copy   manual pipeline, 2 VMEM slots
   manual4_copy   manual pipeline, 4 slots (deeper DMA overlap)
   jnp_copy       XLA's own fused stream (the 640-710 reference point)
+  autoK_stencil / manualN_stencil_kK — the DECISIVE pair for the fused
+      ceiling (VERDICT r3 item 5): identical k-micro-step 5-point stencil
+      compute per chunk (the fused kernels' arithmetic intensity), auto
+      vs manual pipeline.  If manual streams faster AT THIS INTENSITY, a
+      manual-pipeline fused kernel is worth building; if both sit at the
+      auto rate, the 330 GB/s is the DMA engine, not the scheduler, and
+      the writeup closes the avenue.
 
 Usage: python benchmarks/pipeline_probe.py [--probe NAME ...] [--out F]
 Writes/merges JSON records (GB/s) into benchmarks/pipeline_probe.json.
@@ -46,12 +53,39 @@ from mpi_cuda_process_tpu.ops.pallas.kernels import (
 )
 
 
-def _auto_copy(shape, dtype, bz, interpret):
+def _double(block):
+    return block * 2.0
+
+
+def _stencil_transform(k, roll):
+    """k micro-steps of an in-chunk 5-point y/x stencil (rolls on the
+    minor axes only, so chunking on z stays embarrassingly parallel).
+    NOT a correct heat step across chunk boundaries — this is a traffic
+    probe at the fused kernels' arithmetic intensity, not a solver.
+
+    ``roll`` is ``pltpu.roll`` on hardware and ``jnp.roll`` in interpret
+    mode (pltpu.roll does not lower on the CPU interpreter; for this
+    symmetric Laplacian the two are bit-identical), injected so the CI
+    equivalence test exercises the SAME body the chip measures.
+    """
+
+    def transform(block):
+        def micro(_, u):
+            lap = (roll(u, 1, 1) + roll(u, -1, 1)
+                   + roll(u, 1, 2) + roll(u, -1, 2) - 4.0 * u)
+            return u + 0.25 * lap
+
+        return jax.lax.fori_loop(0, k, micro, block)
+
+    return transform
+
+
+def _auto_pipeline(shape, dtype, bz, interpret, transform):
     """pallas_call auto-pipeline: the measured-330 baseline."""
     Z, Y, X = shape
 
     def kernel(i_ref, o_ref):
-        o_ref[...] = i_ref[...] * 2.0
+        o_ref[...] = transform(i_ref[...])
 
     return pl.pallas_call(
         kernel,
@@ -65,7 +99,7 @@ def _auto_copy(shape, dtype, bz, interpret):
     )
 
 
-def _manual_copy_kernel(nslots, bz, nchunks, i_hbm, o_hbm):
+def _manual_pipeline_kernel(nslots, bz, nchunks, transform, i_hbm, o_hbm):
     """N-slot rotating DMA pipeline over z-chunks of a whole-array ref.
 
     Loads overlap compute/stores: slot s starts its load up to nslots-1
@@ -95,7 +129,7 @@ def _manual_copy_kernel(nslots, bz, nchunks, i_hbm, o_hbm):
                 dma(jax.lax.rem(nxt, nslots), nxt).start()
 
             dma(slot, chunk).wait()
-            o_hbm[pl.ds(chunk * bz, bz)] = scratch[slot] * 2.0
+            o_hbm[pl.ds(chunk * bz, bz)] = transform(scratch[slot])
             return ()
 
         jax.lax.fori_loop(0, nchunks, loop, ())
@@ -108,12 +142,13 @@ def _manual_copy_kernel(nslots, bz, nchunks, i_hbm, o_hbm):
     )
 
 
-def _manual_copy(shape, dtype, bz, nslots, interpret):
+def _manual_pipeline(shape, dtype, bz, nslots, interpret, transform):
     Z, Y, X = shape
     nchunks = Z // bz
 
     def kernel(i_hbm, o_hbm):
-        _manual_copy_kernel(nslots, bz, nchunks, i_hbm, o_hbm)
+        _manual_pipeline_kernel(nslots, bz, nchunks, transform, i_hbm,
+                                o_hbm)
 
     return pl.pallas_call(
         kernel,
@@ -127,20 +162,46 @@ def _manual_copy(shape, dtype, bz, nslots, interpret):
 
 
 def build_probe(name, shape, dtype=jnp.float32, bz=16, interpret=None):
-    """Return a jittable ``x -> 2*x`` implementing the named strategy."""
+    """Return a jittable fn implementing the named strategy.
+
+    Copy probes (``*_copy``) compute ``2*x``; stencil probes
+    (``autoK_stencil`` / ``manualN_stencil_kK``) run k in-chunk 5-point
+    micro-steps per pass — the fused kernels' arithmetic intensity.
+    """
     if interpret is None:
         interpret = _interpret_default()
     if name == "jnp_copy":
         return lambda x: x * 2.0
-    if name == "auto_copy":
-        return _auto_copy(shape, dtype, bz, interpret)
+    k = _probe_k(name)
+    if k == 1:
+        transform = _double
+    else:
+        roll = jnp.roll if interpret else pltpu.roll
+        transform = _stencil_transform(k, roll)
+    if name.startswith("auto"):
+        return _auto_pipeline(shape, dtype, bz, interpret, transform)
     if name.startswith("manual"):
-        nslots = int(name[len("manual"):name.index("_")])
-        return _manual_copy(shape, dtype, bz, nslots, interpret)
+        return _manual_pipeline(shape, dtype, bz, _probe_nslots(name),
+                                interpret, transform)
     raise ValueError(f"unknown probe {name!r}")
 
 
-PROBES = ("jnp_copy", "auto_copy", "manual2_copy", "manual4_copy")
+def _probe_k(name):
+    """Micro-steps per pass encoded in the probe name (1 for copies)."""
+    if name.endswith("_stencil"):
+        return int(name[len("auto"):-len("_stencil")])
+    if "_stencil_k" in name:
+        return int(name[name.index("_stencil_k") + len("_stencil_k"):])
+    return 1
+
+
+def _probe_nslots(name):
+    """VMEM slot count encoded in a manual probe's name."""
+    return int(name[len("manual"):name.index("_")])
+
+
+PROBES = ("jnp_copy", "auto_copy", "manual2_copy", "manual4_copy",
+          "auto4_stencil", "manual2_stencil_k4", "manual4_stencil_k4")
 
 
 def measure_probe(name, shape=(512, 512, 512), bz=16, steps=30, reps=3):
@@ -169,9 +230,15 @@ def measure_probe(name, shape=(512, 512, 512), bz=16, steps=30, reps=3):
 
     t = (best(run_b) - best(run_a)) / (3 * steps)
     bytes_per_step = 2 * math.prod(shape) * 4  # 1R + 1W f32
-    return {"gb_per_s": round(bytes_per_step / t / 1e9, 1),
-            "ms_per_pass": round(t * 1e3, 3), "bz": bz,
-            "shape": list(shape)}
+    rec = {"gb_per_s": round(bytes_per_step / t / 1e9, 1),
+           "ms_per_pass": round(t * 1e3, 3), "bz": bz,
+           "shape": list(shape)}
+    k = _probe_k(name)
+    if k > 1:
+        # effective cell rate if a fused kernel streamed at this rate
+        rec["mcells_per_s_equiv"] = round(
+            math.prod(shape) * k / t / 1e6, 1)
+    return rec
 
 
 def main():

@@ -82,6 +82,20 @@ def test_recorded_timeout_retried_after_builder_bump(M, tmp_path):
     assert "mcells_per_s" in got  # re-measured under the newer builder
 
 
+def test_suspect_timeout_retried(M, tmp_path):
+    """A timeout whose post-kill probe failed is ambiguous (the wedge may
+    have predated the label) — it must be retried, not permanently
+    skipped; the start-of-run probe guarantees the retry happens against
+    a healthy tunnel."""
+    out = str(tmp_path / "r.json")
+    (tmp_path / "r.json").write_text(json.dumps({"heat2d_512_f32": {
+        "error": "subprocess timeout (2400s) ... SUSPECT", "timeout": True,
+        "suspect": True, "builder_rev": M.BUILDER_REV}}))
+    _run_single_label(M, out)
+    got = json.loads((tmp_path / "r.json").read_text())["heat2d_512_f32"]
+    assert "mcells_per_s" in got
+
+
 def test_transient_error_still_retried(M, tmp_path):
     out = str(tmp_path / "r.json")
     (tmp_path / "r.json").write_text(json.dumps({"heat2d_512_f32": {

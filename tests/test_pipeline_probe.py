@@ -35,6 +35,31 @@ def test_probe_builds_and_doubles(probe_mod, name):
     np.testing.assert_array_equal(np.asarray(fn(x)), 2.0 * np.asarray(x))
 
 
+@pytest.mark.parametrize("name", ["manual2_stencil_k4",
+                                  "manual4_stencil_k4"])
+def test_stencil_probe_pair_equivalent(probe_mod, name):
+    """The manual-pipeline stencil probes must compute EXACTLY what the
+    auto-pipeline control computes — otherwise the measured pair would
+    compare different work and the ceiling verdict would be garbage."""
+    shape = (8, 8, 128)
+    x = jnp.linspace(0., 1., int(np.prod(shape)),
+                     dtype=jnp.float32).reshape(shape)
+    auto = probe_mod.build_probe("auto4_stencil", shape, bz=2,
+                                 interpret=True)
+    manual = probe_mod.build_probe(name, shape, bz=2, interpret=True)
+    np.testing.assert_array_equal(np.asarray(auto(x)),
+                                  np.asarray(manual(x)))
+
+
+def test_probe_k_parsing(probe_mod):
+    assert probe_mod._probe_k("jnp_copy") == 1
+    assert probe_mod._probe_k("auto4_stencil") == 4
+    assert probe_mod._probe_k("manual2_stencil_k4") == 4
+    # every default probe parses
+    for name in probe_mod.PROBES:
+        probe_mod._probe_k(name)
+
+
 def test_zslab_probe_child_template_is_valid():
     """The zslab VMEM probe's child code must be syntactically valid and
     its construction path must work (interpret mode, tiny shape) — a
