@@ -188,10 +188,15 @@ def build_probe(name, shape, dtype=jnp.float32, bz=16, interpret=None):
 
 def _probe_k(name):
     """Micro-steps per pass encoded in the probe name (1 for copies)."""
-    if name.endswith("_stencil"):
-        return int(name[len("auto"):-len("_stencil")])
-    if "_stencil_k" in name:
-        return int(name[name.index("_stencil_k") + len("_stencil_k"):])
+    try:
+        if name.endswith("_stencil"):
+            return int(name[len("auto"):-len("_stencil")])
+        if "_stencil_k" in name:
+            return int(name[name.index("_stencil_k") + len("_stencil_k"):])
+    except ValueError:
+        # e.g. "manual2_stencil" / "auto_stencil": fail as a usage error,
+        # not a confusing int() traceback in the results record
+        raise ValueError(f"unknown probe {name!r}") from None
     return 1
 
 

@@ -1,0 +1,249 @@
+"""Streaming (sliding-window) temporal-blocking kernel: manual DMA pipeline.
+
+The tiled fused kernels (``fused.py``) pay window READ AMPLIFICATION:
+every (bz, by) tile re-reads its 2*wm-wide overlap with its neighbors, a
+measured (1+2wm/bz)(1+2wm/by) ~= 1.5-2.4x extra HBM traffic.  This module
+removes the z-axis share of that entirely: the kernel slides a window down
+the z axis and keeps the overlap planes resident in a VMEM ring, so every
+input plane is DMA'd from HBM **exactly once per k-step pass**.
+
+Traffic per pass (k steps): ``(1 + 2*wm_a/by) reads + 1 write`` of the
+grid, vs the jnp path's ``2k`` and the tiled kernels' ``~2.4 + 1``.  At
+the measured ~330 GB/s Mosaic DMA rate this projects ~155 Gcells/s for
+heat3d 512^3 f32 k=4 (vs the tiled kernels' measured 107), independent of
+whether a manual pipeline can beat the auto rate (benchmarks/
+pipeline_probe.py answers that separately).
+
+Structure (one ``pallas_call``, grid over y strips):
+  * x: full lane extent, never sliced (taps are lane rolls — fused.py's
+    layout rule).
+  * y: tiled in ``by`` strips; each strip loads ``by + 2*wm_a`` columns
+    where ``wm_a`` is the temporal margin rounded up to the dtype's
+    sublane tile, so every DMA offset is tile-aligned.  This is why bf16
+    works at k=4 here: the tiled kernels need block OFFSETS at 2*wm
+    granularity (hence bf16 k=8), but a strip window only needs sublane
+    alignment of ``ylo``, which rounding the margin provides.
+  * z: sliding window.  The grid is cut into ``nc = Z/bz`` chunks; a
+    4-slot VMEM ring holds the last 4 chunks of the strip.  Computing
+    chunk c needs planes ``[c*bz - wm, (c+1)*bz + wm)`` (clamped at the
+    walls), which with ``2*wm <= bz`` span at most chunks {c-1, c, c+1}
+    — all resident.  Chunk c+2 prefetches (into the slot chunk c-2 no
+    longer needs) while the k micro-steps run, overlapping DMA with
+    compute; the extraction happens BEFORE the prefetch starts, so no
+    read ever races an in-flight DMA.
+
+Correctness is the same argument as the tiled kernels (fused.py): after
+j micro-steps only cells >= j*halo*phases from a non-wall window edge are
+valid; the clamped window keeps the stored core >= wm from every non-wall
+edge, and wall-side cells are re-pinned by the frame mask each micro-step
+(``_window_frame``).  Equivalence vs k plain steps is asserted by
+tests/test_streamfused.py in interpret mode for every family.
+
+Reference anchor: this replaces the role of the reference's per-step
+middle/border kernel pair (kernel.cu:209/221) the same way fused.py does —
+k whole time steps per HBM round-trip — with the DMA schedule written by
+hand instead of by Mosaic's auto-pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..stencil import Fields, Stencil
+
+from .kernels import _VMEM_LIMIT_BYTES, _interpret_default
+from .fused import (
+    _MICRO,
+    _halo_per_micro,
+    _lane_round,
+    _run_micros,
+    _sublane,
+    _window_frame,
+)
+
+_VMEM_LIMIT = int(_VMEM_LIMIT_BYTES * 0.8)
+
+# Ring slots.  4 = the minimum that lets chunk c+2 prefetch while chunks
+# {c-1, c, c+1} stay resident for the current window.
+_NSLOTS = 4
+
+
+def _stream_kernel(micro, nfields, k, halo, wm, wm_a, bz, by, shape,
+                   parity, *refs):
+    """One y strip: slide the z window, k micro-steps per chunk.
+
+    ``refs``: ``nfields`` input HBM refs then ``nfields`` output HBM refs
+    (whole arrays, ``memory_space=ANY``); the strip is selected by
+    ``pl.program_id(0)``.
+    """
+    Z, Y, X = shape
+    nc = Z // bz
+    wz = bz + 2 * wm
+    wy = by + 2 * wm_a
+    ins, outs = refs[:nfields], refs[nfields:]
+    yj = pl.program_id(0)
+    ylo = jnp.clip(yj * by - wm_a, 0, Y - wy)
+
+    def body(scratch, sems):
+        def dma(f, chunk):
+            slot = jax.lax.rem(chunk, _NSLOTS)
+            return pltpu.make_async_copy(
+                ins[f].at[pl.ds(chunk * bz, bz), pl.ds(ylo, wy)],
+                scratch.at[f, pl.ds(slot * bz, bz)],
+                sems.at[f, slot])
+
+        def start_all(chunk):
+            for f in range(nfields):
+                dma(f, chunk).start()
+
+        def wait_all(chunk):
+            for f in range(nfields):
+                dma(f, chunk).wait()
+
+        start_all(0)
+        start_all(1)  # nc >= 3 by the builder's gate
+        wait_all(0)
+
+        def loop(c, _):
+            zlo = jnp.clip(c * bz - wm, 0, Z - wz)
+
+            @pl.when(c + 1 < nc)
+            def _():
+                wait_all(c + 1)
+
+            # Extract the window: the 3 chunks that can contain it (all
+            # waited), concatenated, then sliced at the window origin.
+            base = jnp.clip(c - 1, 0, nc - 3)
+            fields = []
+            for f in range(nfields):
+                parts = [
+                    scratch[f, pl.ds(jax.lax.rem(base + i, _NSLOTS) * bz,
+                                     bz)]
+                    for i in range(3)]
+                fields.append(jax.lax.dynamic_slice(
+                    jnp.concatenate(parts, axis=0),
+                    (zlo - base * bz, 0, 0), (wz, wy, X)))
+            fields = tuple(fields)
+
+            # Prefetch AFTER extraction: chunk c+2's slot held chunk c-2,
+            # which the concat above never reads — no read/DMA race.
+            @pl.when(c + 2 < nc)
+            def _():
+                start_all(c + 2)
+
+            frame, extra = _window_frame((wz, wy, X), zlo, ylo, shape,
+                                         halo, False, parity)
+            fields = _run_micros(micro, fields, frame, extra, k)
+            for f in range(nfields):
+                outs[f][pl.ds(c * bz, bz), pl.ds(yj * by, by)] = (
+                    jax.lax.dynamic_slice(
+                        fields[f], (c * bz - zlo, yj * by - ylo, 0),
+                        (bz, by, X)))
+            return ()
+
+        jax.lax.fori_loop(0, nc, loop, ())
+
+    pl.run_scoped(
+        body,
+        scratch=pltpu.VMEM((nfields, _NSLOTS * bz, wy, X),
+                           ins[0].dtype),
+        sems=pltpu.SemaphoreType.DMA((nfields, _NSLOTS)),
+    )
+
+
+def _pick_strip(Z, Y, X, wm, wm_a, itemsize, nfields):
+    """Choose (bz, by): Z/Y divisors meeting the sliding-window gates and
+    the VMEM budget.  Score: least y read amplification, then largest z
+    chunk (fewer ring warm-ups and sem ops per pass)."""
+    budget_item = max(itemsize, 4)  # bf16 budgeted at the f32 envelope
+    best = None
+    for bz in (32, 16, 8):
+        if Z % bz or 2 * wm > bz or Z // bz < 3:
+            continue
+        for by in (128, 64, 32, 16, 8):
+            if Y % by or by % _sublane(itemsize):
+                continue
+            wy = by + 2 * wm_a
+            if wy > Y:
+                continue
+            wz = bz + 2 * wm
+            lane = _lane_round(X)
+            strip = wy * lane * budget_item
+            # ring + 3-chunk concat + window with ~3 live micro
+            # temporaries + the store slice
+            live = (_NSLOTS * bz * strip + 3 * bz * strip
+                    + 4 * wz * strip + bz * strip) * nfields
+            if live > _VMEM_LIMIT:
+                continue
+            score = (-(wy / by), bz, by)
+            if best is None or score > best[0]:
+                best = (score, (bz, by))
+    return best[1] if best else None
+
+
+def stream_supported(stencil: Stencil) -> bool:
+    return stencil.name in _MICRO and stencil.ndim == 3
+
+
+def make_stream_fused_step(
+    stencil: Stencil,
+    global_shape: Sequence[int],
+    k: int,
+    tiles: Optional[Tuple[int, int]] = None,
+    interpret: Optional[bool] = None,
+):
+    """Build ``fields -> fields`` advancing ``k`` steps in one streaming
+    pass, or None when the shape can't host the sliding window.
+
+    Semantically identical to ``k`` applications of ``driver.make_step``
+    (guard-frame semantics; tests/test_streamfused.py).  Unlike the tiled
+    kernels there is NO ``2*k*halo % sublane`` gate — bf16 runs at k=4.
+    Guard-frame (non-periodic) only.
+    """
+    if not stream_supported(stencil):
+        return None
+    if interpret is None:
+        interpret = _interpret_default()
+    Z, Y, X = (int(s) for s in global_shape)
+    micro_factory, halo, nfields = _MICRO[stencil.name]
+    wm = k * _halo_per_micro(stencil)
+    itemsize = jnp.dtype(stencil.dtype).itemsize
+    sub = _sublane(itemsize)
+    wm_a = -(-wm // sub) * sub  # margin rounded to a DMA-alignable offset
+    if tiles is None:
+        tiles = _pick_strip(Z, Y, X, wm, wm_a, itemsize, nfields)
+        if tiles is None:
+            return None
+    bz, by = tiles
+    if (Z % bz or Y % by or 2 * wm > bz or Z // bz < 3
+            or by % sub or by + 2 * wm_a > Y):
+        return None
+    micro = micro_factory(stencil, interpret)
+    parity = bool(stencil.phases)
+
+    def kernel(*refs):
+        _stream_kernel(micro, nfields, k, halo, wm, wm_a, bz, by,
+                       (Z, Y, X), parity, *refs)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(Y // by,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * nfields,
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * nfields,
+        out_shape=[jax.ShapeDtypeStruct((Z, Y, X), stencil.dtype)
+                   for _ in range(nfields)],
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT_BYTES,
+            dimension_semantics=("arbitrary",)),
+    )
+
+    def step_k(fields: Fields) -> Fields:
+        return tuple(call(*fields))
+
+    return step_k

@@ -1,0 +1,118 @@
+"""Streaming fused kernel == k plain steps (interpret mode).
+
+Same contract as tests/test_fused.py: ``make_stream_fused_step`` must be
+semantically identical to k applications of ``driver.make_step`` —
+guard-frame pinning, multi-field carries, red-black parity, halo-2
+margins, and bf16 at k=4 (the streaming kernel's alignment advantage
+over the tiled kernels, which require bf16 k=8).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_process_tpu import init_state, make_step, make_stencil
+from mpi_cuda_process_tpu.ops.pallas.streamfused import (
+    make_stream_fused_step,
+)
+
+
+def _equiv(name, grid, k, dtype=None, tiles=None, steps=None, tol=1e-4,
+           **params):
+    """Same contract as tests/test_fused.py: k>1 windows accumulate in a
+    different (window-local) association order, so a few-ULP atol; k=1
+    (tol=0) is bit-exact."""
+    kw = dict(params)
+    if dtype is not None:
+        kw["dtype"] = dtype
+    st = make_stencil(name, **kw)
+    stream = make_stream_fused_step(st, grid, k, tiles=tiles,
+                                    interpret=True)
+    assert stream is not None, f"stream kernel declined {name} {grid} k={k}"
+    plain = make_step(st, grid)
+    fields = init_state(st, grid, kind="auto", seed=7)
+    ref = fields
+    for _ in range(steps or k):
+        ref = plain(ref)
+    got = fields
+    for _ in range((steps or k) // k):
+        got = stream(got)
+    for g, r in zip(got, ref):
+        if tol:
+            np.testing.assert_allclose(np.asarray(g, dtype=np.float32),
+                                       np.asarray(r, dtype=np.float32),
+                                       rtol=0, atol=tol)
+        else:
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+
+def test_heat3d_k1_bitexact():
+    _equiv("heat3d", (24, 32, 128), 1, tiles=(8, 16), tol=0.0)
+
+
+def test_heat3d():
+    _equiv("heat3d", (24, 32, 128), 4)
+
+
+def test_heat3d_two_passes():
+    _equiv("heat3d", (24, 32, 128), 4, steps=8)
+
+
+def test_heat3d_uneven_extents():
+    # Z not a multiple of the largest chunk; Y larger than one strip
+    _equiv("heat3d", (40, 64, 128), 4)
+
+
+def test_heat3d_bf16_k4():
+    """bf16 at k=4: impossible for the tiled kernels (sublane-16 forces
+    k=8 there); the streaming kernel only needs the margin ROUNDED to the
+    sublane tile, not the block offsets."""
+    _equiv("heat3d", (24, 64, 128), 4, dtype=jnp.bfloat16)
+
+
+def test_heat3d_explicit_tiles():
+    _equiv("heat3d", (24, 32, 128), 4, tiles=(8, 16))
+
+
+def test_heat3d_rejects_bad_tiles():
+    st = make_stencil("heat3d")
+    # 2*wm > bz
+    assert make_stream_fused_step(st, (24, 32, 128), 4, tiles=(4, 16),
+                                  interpret=True) is None
+    # fewer than 3 chunks
+    assert make_stream_fused_step(st, (16, 32, 128), 4, tiles=(8, 16),
+                                  interpret=True) is None
+
+
+def test_wave3d_two_fields():
+    _equiv("wave3d", (24, 32, 128), 4)
+
+
+def test_grayscott3d_coupled_fields():
+    _equiv("grayscott3d", (24, 32, 128), 4)
+
+
+def test_advect3d():
+    _equiv("advect3d", (24, 32, 128), 4)
+
+
+def test_heat3d27():
+    _equiv("heat3d27", (24, 32, 128), 4)
+
+
+def test_heat3d4th_halo2():
+    # halo 2: wm = 2k = 8 -> bz >= 16, Z >= 48
+    _equiv("heat3d4th", (48, 32, 128), 4)
+
+
+def test_sor3d_parity():
+    # red-black: wm = 2k (phase-aware margins); parity from global coords
+    _equiv("sor3d", (48, 32, 128), 4)
+
+
+def test_declines_2d_and_unknown():
+    assert make_stream_fused_step(make_stencil("heat2d"), (64, 128), 4,
+                                  interpret=True) is None
+    assert make_stream_fused_step(make_stencil("life"), (64, 64), 4,
+                                  interpret=True) is None
