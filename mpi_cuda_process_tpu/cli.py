@@ -115,6 +115,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "measured-fastest path for heat3d/heat3d27/wave3d, "
                         "auto-selected there; composes with --mesh, "
                         "--periodic, and --tol)")
+    p.add_argument("--fuse-kind", default="auto",
+                   choices=["auto", "tiled", "padfree", "stream"],
+                   help="which 3D fused kernel carries --fuse (unsharded "
+                        "runs): tiled = padded 4-block windows; padfree = "
+                        "9-block raw-grid (no pad transient, 1024^3-class "
+                        "grids); stream = sliding-window manual-DMA "
+                        "pipeline (every plane read once per pass; bf16 "
+                        "works at k=4); auto = the measured default "
+                        "(padfree above the HBM threshold, else tiled)")
     p.add_argument("--mem-check", default="error",
                    choices=["error", "warn", "off"],
                    help="per-device HBM budget guard (TPU runs): estimate "
@@ -135,7 +144,8 @@ def config_from_args(argv=None) -> RunConfig:
         checkpoint_backend=a.checkpoint_backend,
         resume=a.resume, render=a.render, profile_dir=a.profile_dir,
         compute=a.compute, overlap=a.overlap, ensemble=a.ensemble,
-        fuse=a.fuse, tol=a.tol, tol_check_every=a.tol_check_every,
+        fuse=a.fuse, fuse_kind=a.fuse_kind,
+        tol=a.tol, tol_check_every=a.tol_check_every,
         check_finite=a.check_finite, debug_checks=a.debug_checks,
         dump_every=a.dump_every, dump_dir=a.dump_dir,
         mem_check=a.mem_check,
@@ -356,10 +366,20 @@ def build(cfg: RunConfig):
     if cfg.ensemble and cfg.mesh and math.prod(cfg.mesh) > 1:
         raise ValueError("--ensemble currently excludes --mesh; "
                          "use one batching strategy at a time")
+    if cfg.fuse_kind != "auto" and not cfg.fuse:
+        # a forced kind with auto-selected fuse would route maybe_auto_fuse
+        # upgrades into a kernel that was never probed (and silently no-op
+        # off-TPU) — require the explicit pairing
+        raise ValueError("--fuse-kind requires an explicit --fuse K")
     if cfg.fuse:
         if cfg.compute == "pallas" or cfg.overlap:
             raise ValueError("--fuse replaces the whole step; it excludes "
                              "--compute pallas and --overlap")
+        if cfg.fuse_kind != "auto" and (use_mesh or st.ndim == 2):
+            raise ValueError(
+                "--fuse-kind selects among the UNSHARDED 3D kernels; "
+                "sharded runs use the exchange-composed kernels and 2D "
+                "grids the whole-grid VMEM kernel (leave it 'auto')")
         if use_mesh:
             # k fused steps per width-k*halo exchange (the 4096^3-class
             # configuration: decomposition AND temporal blocking); 2D
@@ -384,14 +404,33 @@ def build(cfg: RunConfig):
                     f"--fuse {cfg.fuse} unsupported for {st.name} on grid "
                     f"{cfg.grid} (needs a 2D micro family, sublane/lane-"
                     f"aligned extents, and a grid within the VMEM budget)")
+        elif cfg.fuse_kind == "stream":
+            from .ops.pallas.streamfused import make_stream_fused_step
+
+            if cfg.periodic or cfg.ensemble:
+                raise ValueError(
+                    "--fuse-kind stream is guard-frame, unbatched only "
+                    "(the manual-DMA kernel has no periodic wrap path and "
+                    "does not vmap)")
+            fused = make_stream_fused_step(st, cfg.grid, cfg.fuse)
+            if fused is None:
+                raise ValueError(
+                    f"--fuse {cfg.fuse} --fuse-kind stream unsupported for "
+                    f"{st.name} on {cfg.grid}: needs a 3D fused family, "
+                    f"Z >= 3 z-chunks of >= 2*k*halo planes, and a y strip "
+                    f"within the VMEM budget")
         else:
             from .ops.pallas.fused import make_fused_step, prefer_padfree
             # pad-free (9-block raw-grid) kernel for 1024^3-class grids,
             # where the padded path's full-grid pad transient exhausts HBM
-            padfree = prefer_padfree(st, cfg.grid, batch=cfg.ensemble or 1)
+            if cfg.fuse_kind == "auto":
+                padfree = prefer_padfree(st, cfg.grid,
+                                         batch=cfg.ensemble or 1)
+            else:
+                padfree = cfg.fuse_kind == "padfree"
             fused = make_fused_step(st, cfg.grid, cfg.fuse,
                                     periodic=cfg.periodic, padfree=padfree)
-            if fused is None and padfree:
+            if fused is None and padfree and cfg.fuse_kind == "auto":
                 # pad-free untileable (VMEM window gate): padded fallback
                 fused = make_fused_step(st, cfg.grid, cfg.fuse,
                                         periodic=cfg.periodic)
@@ -538,7 +577,7 @@ def _check_mem_budget(cfg: RunConfig) -> None:
         total, parts = budget.check_budget(
             st, cfg.grid, mesh=cfg.mesh, fuse=cfg.fuse,
             ensemble=cfg.ensemble, periodic=cfg.periodic,
-            compute=compute)
+            compute=compute, fuse_kind=cfg.fuse_kind)
     except ValueError:
         if cfg.mem_check == "error":
             raise

@@ -37,6 +37,10 @@ class RunConfig:
     overlap: bool = False  # explicit interior/boundary split for comm overlap
     ensemble: int = 0  # >0: batch of independent universes via vmap
     fuse: int = 0  # >0: temporal blocking, k steps per HBM pass (experimental)
+    # which fused kernel carries --fuse (3D unsharded only; auto = measured
+    # default): tiled (padded 4-block) | padfree (9-block raw-grid) |
+    # stream (sliding-window manual DMA, ops/pallas/streamfused.py)
+    fuse_kind: str = "auto"
     check_finite: int = 0  # >0: assert all fields finite every N steps
     debug_checks: bool = False  # checkify NaN/bounds checks, step-localized
     tol: float = 0.0  # >0: stop when residual < tol (lax.while_loop runner)

@@ -61,6 +61,7 @@ def estimate_run_bytes(
     ensemble: int = 0,
     periodic: bool = False,
     compute: str = "auto",
+    fuse_kind: str = "auto",
 ) -> Tuple[int, List[Tuple[str, int]]]:
     """Peak per-device live bytes for a run, with a labeled breakdown.
 
@@ -128,7 +129,30 @@ def estimate_run_bytes(
             parts.append(
                 (f"sharded fused: {nfields} exchange-padded block(s) "
                  f"(+{2 * m} z/y)", nfields * padded_b))
-        elif prefer_padfree(stencil, grid, batch=batch) \
+        elif fuse_kind == "stream":
+            # sliding-window manual-DMA kernel: the ring lives in VMEM,
+            # HBM holds only state + output.  Probe construction (pure
+            # Python) so a "fits" never describes an unconstructible run;
+            # when unbuildable, cli.build refuses before any allocation.
+            from ..ops.pallas.streamfused import make_stream_fused_step
+
+            ok = make_stream_fused_step(stencil, grid, fuse,
+                                        interpret=True) is not None
+            parts.append(
+                ("streaming fused: no pad transient" if ok else
+                 "streaming fused: UNBUILDABLE for this shape (the run "
+                 "refuses before allocating)", 0))
+        elif fuse_kind == "padfree":
+            # forced pad-free: there is no padded fallback (cli.build
+            # raises instead), so never estimate the padded transient
+            ok = make_fused_step(stencil, grid, fuse, interpret=True,
+                                 periodic=periodic, padfree=True) is not None
+            parts.append(
+                ("pad-free fused: no pad transient" if ok else
+                 "pad-free fused: UNBUILDABLE for this shape (the run "
+                 "refuses before allocating)", 0))
+        elif fuse_kind == "auto" \
+                and prefer_padfree(stencil, grid, batch=batch) \
                 and make_fused_step(stencil, grid, fuse,
                                     interpret=True, periodic=periodic,
                                     padfree=True) is not None:
@@ -180,6 +204,7 @@ def check_budget(
     ensemble: int = 0,
     periodic: bool = False,
     compute: str = "auto",
+    fuse_kind: str = "auto",
     hbm_bytes: Optional[int] = None,
 ) -> Tuple[int, List[Tuple[str, int]]]:
     """Raise ValueError with the arithmetic when the run cannot fit.
@@ -189,7 +214,7 @@ def check_budget(
     hbm = hbm_bytes if hbm_bytes is not None else device_hbm_bytes()
     total, parts = estimate_run_bytes(
         stencil, grid, mesh=mesh, fuse=fuse, ensemble=ensemble,
-        periodic=periodic, compute=compute)
+        periodic=periodic, compute=compute, fuse_kind=fuse_kind)
     if total > hbm:
         raise ValueError(
             f"config needs ~{total / 2**30:.2f} GiB per device but HBM is "

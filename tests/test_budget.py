@@ -106,6 +106,36 @@ def test_raw_path_has_no_transient():
         budget.check_budget(st, grid, hbm_bytes=16 * GiB)
 
 
+def test_stream_kind_has_no_transient_and_probes_buildability():
+    """--fuse-kind stream: the ring lives in VMEM, so HBM holds state +
+    output only; the estimate must probe construction so a 'fits' never
+    describes an unconstructible run (the budget module's invariant)."""
+    st = make_stencil("heat3d")
+    total, parts = budget.estimate_run_bytes(
+        st, (1024,) * 3, fuse=4, fuse_kind="stream")
+    assert any("streaming fused: no pad transient" in label
+               for label, _ in parts)
+    # 4 GiB state + 4 out + 10% < 16 GiB: the 1024^3 f32 single-chip path
+    budget.check_budget(st, (1024,) * 3, fuse=4, fuse_kind="stream",
+                        hbm_bytes=16 * GiB)
+    # unbuildable shape (too few z chunks): labeled, never silently 'fits'
+    _, parts2 = budget.estimate_run_bytes(
+        st, (16, 16, 128), fuse=4, fuse_kind="stream")
+    assert any("UNBUILDABLE" in label for label, _ in parts2)
+
+
+def test_forced_padfree_never_estimates_the_padded_transient():
+    """fuse_kind='padfree' has no padded fallback in cli.build — the
+    estimate must not charge padded-transient bytes the run would never
+    allocate (it raises instead)."""
+    st = make_stencil("heat3d")
+    # a shape the padfree builder declines (odd extents)
+    t_forced, parts = budget.estimate_run_bytes(
+        st, (20, 20, 128), fuse=4, fuse_kind="padfree")
+    assert any("pad-free fused" in label for label, _ in parts)
+    assert not any("pad transient (+" in label for label, _ in parts)
+
+
 def test_f32_at_4096_fits_on_z_only_mesh_padfree():
     """The round-4 headline budget row: 4096^3 in FULL f32 fits a 64-chip
     v5e on a z-only mesh with the z-slab pad-free kernel (~9.35 GiB) —

@@ -272,6 +272,53 @@ def test_fuse_rejects_bad_configs():
         build(RunConfig(stencil="life", grid=(16, 16), iters=8, fuse=4))
 
 
+def test_fuse_kind_stream_matches_plain_run():
+    """--fuse K --fuse-kind stream (sliding-window manual-DMA kernel) must
+    agree with the plain run to the fused-window tolerance."""
+    base = dict(stencil="heat3d", grid=(24, 32, 128), iters=8,
+                init="random", seed=2)
+    plain, _ = run(RunConfig(**base))
+    stream, _ = run(RunConfig(**base, fuse=4, fuse_kind="stream"))
+    np.testing.assert_allclose(
+        np.asarray(stream[0]), np.asarray(plain[0]), rtol=0, atol=1e-4)
+
+
+def test_fuse_kind_padfree_matches_plain_run():
+    base = dict(stencil="heat3d", grid=(16, 16, 128), iters=8,
+                init="random", seed=2)
+    plain, _ = run(RunConfig(**base))
+    pf, _ = run(RunConfig(**base, fuse=4, fuse_kind="padfree"))
+    np.testing.assert_allclose(
+        np.asarray(pf[0]), np.asarray(plain[0]), rtol=0, atol=1e-4)
+
+
+def test_fuse_kind_rejects_bad_configs():
+    import pytest
+
+    # stream: guard-frame, unbatched, unsharded 3D only
+    with pytest.raises(ValueError, match="stream"):
+        build(RunConfig(stencil="heat3d", grid=(24, 32, 128), iters=8,
+                        fuse=4, fuse_kind="stream", periodic=True))
+    with pytest.raises(ValueError, match="stream"):
+        build(RunConfig(stencil="heat3d", grid=(24, 32, 128), iters=8,
+                        fuse=4, fuse_kind="stream", ensemble=2))
+    with pytest.raises(ValueError, match="fuse-kind"):
+        build(RunConfig(stencil="heat3d", grid=(16, 16, 128), iters=8,
+                        fuse=4, fuse_kind="stream", mesh=(2, 1, 1)))
+    with pytest.raises(ValueError, match="fuse-kind"):
+        build(RunConfig(stencil="heat2d", grid=(64, 128), iters=8,
+                        fuse=4, fuse_kind="tiled"))
+    # too few z chunks for the sliding window
+    with pytest.raises(ValueError, match="stream"):
+        build(RunConfig(stencil="heat3d", grid=(16, 16, 128), iters=8,
+                        fuse=4, fuse_kind="stream"))
+    # forced kind without an explicit k: maybe_auto_fuse upgrades must
+    # never be routed into a kernel that was never probed
+    with pytest.raises(ValueError, match="fuse-kind"):
+        build(RunConfig(stencil="heat3d", grid=(24, 32, 128), iters=8,
+                        fuse_kind="stream"))
+
+
 def test_dump_every_writes_snapshots(tmp_path):
     d = str(tmp_path / "dumps")
     run(RunConfig(stencil="heat2d", grid=(16, 16), iters=10,
