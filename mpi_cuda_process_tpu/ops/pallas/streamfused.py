@@ -73,29 +73,41 @@ _VMEM_LIMIT = int(_VMEM_LIMIT_BYTES * 0.8)
 _NSLOTS = 4
 
 
-def _stream_kernel(micro, nfields, k, halo, wm, wm_a, bz, by, shape,
-                   parity, *refs):
-    """One y strip: slide the z window, k micro-steps per chunk.
+def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, lshape,
+                 gshape, parity, origin_z, ins, outs, slabs):
+    """One y strip: slide the z window down the local block, k micro-steps
+    per chunk.
 
-    ``refs``: ``nfields`` input HBM refs then ``nfields`` output HBM refs
-    (whole arrays, ``memory_space=ANY``); the strip is selected by
-    ``pl.program_id(0)``.
+    ``lshape`` is the LOCAL (Lz, Y, X); ``gshape`` the global shape the
+    frame mask is derived against, with ``origin_z`` this block's global
+    z origin (0 / static for the unsharded kernel, an SMEM scalar when
+    sharded).  ``slabs`` is None (unsharded: windows CLAMP at the z walls
+    and the frame re-pins them) or a pair of (wm, Y, X) HBM refs per
+    field holding the exchanged neighbor slabs (sharded: edge chunks
+    substitute slab planes for the clamped overhang, so the window sees
+    genuine neighbor values).
     """
-    Z, Y, X = shape
-    nc = Z // bz
+    Lz, Y, X = lshape
+    nc = Lz // bz
     wz = bz + 2 * wm
     wy = by + 2 * wm_a
-    ins, outs = refs[:nfields], refs[nfields:]
     yj = pl.program_id(0)
     ylo = jnp.clip(yj * by - wm_a, 0, Y - wy)
 
-    def body(scratch, sems):
+    def body(scratch, sems, slab_mem=None, slab_sems=None):
         def dma(f, chunk):
-            slot = jax.lax.rem(chunk, _NSLOTS)
+            slot = jax.lax.rem(chunk, _NSLOTS) if _traced(chunk) \
+                else chunk % _NSLOTS
             return pltpu.make_async_copy(
                 ins[f].at[pl.ds(chunk * bz, bz), pl.ds(ylo, wy)],
                 scratch.at[f, pl.ds(slot * bz, bz)],
                 sems.at[f, slot])
+
+        def slab_dma(f, side):
+            return pltpu.make_async_copy(
+                slabs[f][side].at[:, pl.ds(ylo, wy)],
+                slab_mem.at[f, side],
+                slab_sems.at[f, side])
 
         def start_all(chunk):
             for f in range(nfields):
@@ -105,58 +117,127 @@ def _stream_kernel(micro, nfields, k, halo, wm, wm_a, bz, by, shape,
             for f in range(nfields):
                 dma(f, chunk).wait()
 
+        if slabs is not None:
+            for f in range(nfields):
+                for side in (0, 1):
+                    slab_dma(f, side).start()
         start_all(0)
         start_all(1)  # nc >= 3 by the builder's gate
         wait_all(0)
+        if slabs is not None:
+            for f in range(nfields):
+                for side in (0, 1):
+                    slab_dma(f, side).wait()
 
-        def loop(c, _):
-            zlo = jnp.clip(c * bz - wm, 0, Z - wz)
-
-            @pl.when(c + 1 < nc)
-            def _():
+        def process(c, is_lo, is_hi):
+            """One chunk.  ``c`` is a Python int for the peeled edge
+            chunks (all extraction offsets become static) and a traced
+            scalar for the interior ``fori_loop``.  The slab splice
+            exists only in the edge bodies — interior chunks pay zero
+            select/concat overhead."""
+            if is_lo:
+                zlo, base = 0, 0          # clamped window [0, wz)
+            elif is_hi:
+                zlo, base = Lz - wz, nc - 3
+            else:
+                zlo, base = c * bz - wm, c - 1  # interior: never clamps
+            if not is_hi:
                 wait_all(c + 1)
 
-            # Extract the window: the 3 chunks that can contain it (all
-            # waited), concatenated, then sliced at the window origin.
-            base = jnp.clip(c - 1, 0, nc - 3)
+            # Extract the window: 3 consecutive ring chunks concatenated,
+            # then sliced at the window origin — which is STATIC relative
+            # to the concat base in every case (interior: bz - wm).
             fields = []
             for f in range(nfields):
-                parts = [
-                    scratch[f, pl.ds(jax.lax.rem(base + i, _NSLOTS) * bz,
-                                     bz)]
-                    for i in range(3)]
-                fields.append(jax.lax.dynamic_slice(
-                    jnp.concatenate(parts, axis=0),
-                    (zlo - base * bz, 0, 0), (wz, wy, X)))
+                parts = []
+                for i in range(3):
+                    ci = base + i
+                    slot = (jax.lax.rem(ci, _NSLOTS) if _traced(ci)
+                            else ci % _NSLOTS)
+                    parts.append(scratch[f, pl.ds(slot * bz, bz)])
+                off = zlo - base * bz if not _traced(base) else bz - wm
+                win = jnp.concatenate(parts, axis=0)[off:off + wz]
+                if slabs is not None and is_lo:
+                    # the true window overhangs the block by wm planes:
+                    # splice the exchanged slab in place of the clamped
+                    # re-read (interior chunks never clamp: bz >= 2*wm)
+                    win = jnp.concatenate(
+                        [slab_mem[f, 0], win[:wz - wm]], axis=0)
+                elif slabs is not None and is_hi:
+                    win = jnp.concatenate(
+                        [win[wm:], slab_mem[f, 1]], axis=0)
+                fields.append(win)
             fields = tuple(fields)
 
             # Prefetch AFTER extraction: chunk c+2's slot held chunk c-2,
             # which the concat above never reads — no read/DMA race.
-            @pl.when(c + 2 < nc)
-            def _():
-                start_all(c + 2)
+            if is_lo:
+                if 2 < nc:
+                    start_all(2)
+            elif not is_hi:
+                @pl.when(c + 2 < nc)
+                def _():
+                    start_all(c + 2)
 
-            frame, extra = _window_frame((wz, wy, X), zlo, ylo, shape,
+            # The TRUE window origin: with slabs, edge windows really
+            # start at c*bz - wm (slab planes); clamped-only windows
+            # start at zlo.
+            if slabs is not None:
+                z0 = origin_z + c * bz - wm
+                store_z = wm  # the core sits mid-window always
+            else:
+                z0 = origin_z + zlo
+                store_z = c * bz - zlo if not _traced(c) else wm
+            frame, extra = _window_frame((wz, wy, X), z0, ylo, gshape,
                                          halo, False, parity)
             fields = _run_micros(micro, fields, frame, extra, k)
             for f in range(nfields):
                 outs[f][pl.ds(c * bz, bz), pl.ds(yj * by, by)] = (
                     jax.lax.dynamic_slice(
-                        fields[f], (c * bz - zlo, yj * by - ylo, 0),
+                        fields[f], (store_z, yj * by - ylo, 0),
                         (bz, by, X)))
-            return ()
 
-        jax.lax.fori_loop(0, nc, loop, ())
+        process(0, True, False)
+        jax.lax.fori_loop(
+            1, nc - 1, lambda c, _: (process(c, False, False), ())[1], ())
+        process(nc - 1, False, True)
 
-    pl.run_scoped(
-        body,
-        scratch=pltpu.VMEM((nfields, _NSLOTS * bz, wy, X),
-                           ins[0].dtype),
+    kwargs = dict(
+        scratch=pltpu.VMEM((nfields, _NSLOTS * bz, wy, X), ins[0].dtype),
         sems=pltpu.SemaphoreType.DMA((nfields, _NSLOTS)),
     )
+    if slabs is not None:
+        kwargs["slab_mem"] = pltpu.VMEM((nfields, 2, wm, wy, X),
+                                        ins[0].dtype)
+        kwargs["slab_sems"] = pltpu.SemaphoreType.DMA((nfields, 2))
+    pl.run_scoped(body, **kwargs)
 
 
-def _pick_strip(Z, Y, X, wm, wm_a, itemsize, nfields):
+def _traced(v) -> bool:
+    return not isinstance(v, int)
+
+
+def _stream_kernel(micro, nfields, k, halo, wm, wm_a, bz, by, shape,
+                   parity, *refs):
+    """Unsharded wrapper: ``refs`` = nfields input HBM refs then nfields
+    output HBM refs (whole arrays, ``memory_space=ANY``)."""
+    _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, shape, shape,
+                 parity, 0, refs[:nfields], refs[nfields:], None)
+
+
+def _stream_sharded_kernel(micro, nfields, k, halo, wm, wm_a, bz, by,
+                           lshape, gshape, parity, *refs):
+    """Sharded wrapper: ``refs`` = origins (SMEM int32 (2,)), then per
+    field [core, slab_lo, slab_hi] HBM refs, then nfields outputs."""
+    origins, refs = refs[0], refs[1:]
+    ins = [refs[3 * f] for f in range(nfields)]
+    slabs = [(refs[3 * f + 1], refs[3 * f + 2]) for f in range(nfields)]
+    outs = refs[3 * nfields:]
+    _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, lshape,
+                 gshape, parity, origins[0], ins, outs, slabs)
+
+
+def _pick_strip(Z, Y, X, wm, wm_a, itemsize, nfields, sharded=False):
     """Choose (bz, by): Z/Y divisors meeting the sliding-window gates and
     the VMEM budget.  Score: least y read amplification, then largest z
     chunk (fewer ring warm-ups and sem ops per pass)."""
@@ -178,6 +259,10 @@ def _pick_strip(Z, Y, X, wm, wm_a, itemsize, nfields):
             # temporaries + the store slice
             live = (_NSLOTS * bz * strip + 3 * bz * strip
                     + 4 * wz * strip + bz * strip) * nfields
+            if sharded:
+                # the slab ring (both sides, every field) + the edge
+                # chunks' splice-concat temporary
+                live += (2 * 2 * wm * strip + wz * strip) * nfields
             if live > _VMEM_LIMIT:
                 continue
             score = (-(wy / by), bz, by)
@@ -188,6 +273,86 @@ def _pick_strip(Z, Y, X, wm, wm_a, itemsize, nfields):
 
 def stream_supported(stencil: Stencil) -> bool:
     return stencil.name in _MICRO and stencil.ndim == 3
+
+
+def _stream_gates(stencil, Lz, Y, X, k, tiles, sharded=False):
+    """Shared builder gates; returns (bz, by, wm, wm_a, ...) or None."""
+    micro_factory, halo, nfields = _MICRO[stencil.name]
+    wm = k * _halo_per_micro(stencil)
+    itemsize = jnp.dtype(stencil.dtype).itemsize
+    sub = _sublane(itemsize)
+    wm_a = -(-wm // sub) * sub  # margin rounded to a DMA-alignable offset
+    if tiles is None:
+        tiles = _pick_strip(Lz, Y, X, wm, wm_a, itemsize, nfields,
+                            sharded=sharded)
+        if tiles is None:
+            return None
+    bz, by = tiles
+    if (Lz % bz or Y % by or 2 * wm > bz or Lz // bz < 3
+            or by % sub or by + 2 * wm_a > Y):
+        return None
+    return micro_factory, halo, nfields, wm, wm_a, bz, by
+
+
+def build_stream_sharded_call(
+    stencil: Stencil,
+    local_shape: Tuple[int, int, int],
+    global_shape: Tuple[int, int, int],
+    k: int,
+    tiles: Optional[Tuple[int, int]] = None,
+    interpret: Optional[bool] = None,
+    periodic: bool = False,
+):
+    """Streaming kernel over a z-decomposed LOCAL block: the config-5
+    execution with sliding-window traffic.
+
+    The call takes origins (int32 (2,)), then per field
+    ``[core, slab_lo, slab_hi]`` (the width-``m`` exchanged neighbor
+    slabs as separate operands — no exchange-padded copy exists, same
+    contract as ``fused.build_zslab_padfree_call`` with layout (1, 1)),
+    and returns ``nfields`` local-shape arrays advanced k steps.
+    Returns ``(call, margin, nfields)`` or None.
+
+    Edge z-chunks substitute slab planes for the unsharded kernel's
+    clamped re-read, so interior shards see genuine neighbor values; at
+    the global walls the slabs hold the bc fill and the frame mask
+    re-pins them (ghost planes included), exactly like the z-slab tiled
+    kernels.  vs the wide-X kernel's (1+4m/bz)(1+4m/by)(1+256/bx) read
+    amplification (~4.5x for config-5 wave), streaming reads each plane
+    once (+ the y-strip margin ~1.13x) — the projected config-5 winner.
+    Guard-frame only (periodic declines; the sharded caller falls back).
+    """
+    if periodic or not stream_supported(stencil):
+        return None
+    if interpret is None:
+        interpret = _interpret_default()
+    Lz, Y, X = (int(s) for s in local_shape)
+    gshape = tuple(int(s) for s in global_shape)
+    gates = _stream_gates(stencil, Lz, Y, X, k, tiles, sharded=True)
+    if gates is None:
+        return None
+    micro_factory, halo, nfields, wm, wm_a, bz, by = gates
+    micro = micro_factory(stencil, interpret)
+    parity = bool(stencil.phases)
+
+    def kernel(*refs):
+        _stream_sharded_kernel(micro, nfields, k, halo, wm, wm_a, bz, by,
+                               (Lz, Y, X), gshape, parity, *refs)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(Y // by,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [pl.BlockSpec(memory_space=pl.ANY)] * (3 * nfields),
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * nfields,
+        out_shape=[jax.ShapeDtypeStruct((Lz, Y, X), stencil.dtype)
+                   for _ in range(nfields)],
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT_BYTES,
+            dimension_semantics=("arbitrary",)),
+    )
+    return call, wm, nfields
 
 
 def make_stream_fused_step(
@@ -210,19 +375,10 @@ def make_stream_fused_step(
     if interpret is None:
         interpret = _interpret_default()
     Z, Y, X = (int(s) for s in global_shape)
-    micro_factory, halo, nfields = _MICRO[stencil.name]
-    wm = k * _halo_per_micro(stencil)
-    itemsize = jnp.dtype(stencil.dtype).itemsize
-    sub = _sublane(itemsize)
-    wm_a = -(-wm // sub) * sub  # margin rounded to a DMA-alignable offset
-    if tiles is None:
-        tiles = _pick_strip(Z, Y, X, wm, wm_a, itemsize, nfields)
-        if tiles is None:
-            return None
-    bz, by = tiles
-    if (Z % bz or Y % by or 2 * wm > bz or Z // bz < 3
-            or by % sub or by + 2 * wm_a > Y):
+    gates = _stream_gates(stencil, Z, Y, X, k, tiles)
+    if gates is None:
         return None
+    micro_factory, halo, nfields, wm, wm_a, bz, by = gates
     micro = micro_factory(stencil, interpret)
     parity = bool(stencil.phases)
 

@@ -226,6 +226,7 @@ def make_sharded_fused_step(
     interpret: Optional[bool] = None,
     periodic: bool = False,
     padfree: Optional[bool] = None,
+    kind: Optional[str] = None,
 ):
     """Temporal blocking under domain decomposition: k steps per exchange.
 
@@ -269,6 +270,12 @@ def make_sharded_fused_step(
     ``None`` = auto: pad-free when the padded copies would exceed the
     same HBM threshold the single-chip path uses (``prefer_padfree`` on
     the local block), padded (the measured configuration) below it.
+
+    ``kind="stream"`` forces the sliding-window streaming kernel
+    (ops/pallas/streamfused.py, z-only meshes, guard-frame): slab
+    operands like the z-slab kernels, but every core plane is DMA'd once
+    per pass — the projected config-5 winner, pending real-chip
+    measurement (auto policy unchanged until then).
     """
     from ..ops.pallas.fused import (
         build_fused_call,
@@ -278,6 +285,11 @@ def make_sharded_fused_step(
     )
 
     ndim = stencil.ndim
+    if kind not in (None, "stream"):
+        # a typo'd or unsupported kind must not silently measure the
+        # auto-selected kernel under the wrong label
+        raise ValueError(f"unknown sharded fused kind {kind!r} "
+                         "(None=auto, 'stream')")
     if ndim != 3 or not fused_supported(stencil):
         return None
     axis_names, counts = _resolve_mesh_axes(ndim, mesh)
@@ -288,6 +300,17 @@ def make_sharded_fused_step(
     local_shape = tuple(g // c for g, c in zip(global_shape, counts))
 
     z_only = counts[1] == 1
+    if kind == "stream":
+        # forced streaming (sliding-window manual DMA): z-only meshes,
+        # guard-frame — the measured-policy candidate for config 5 (the
+        # wide-X kernel's 4.5x read amplification vs streaming's ~1.13x)
+        from ..ops.pallas.streamfused import build_stream_sharded_call
+
+        if not z_only:
+            return None
+        return _make_zslab_padfree_step(
+            stencil, mesh, global_shape, local_shape, axis_names, counts,
+            k, build_stream_sharded_call, (1, 1), interpret, periodic)
     if padfree is None:
         padfree = z_only and prefer_padfree(stencil, local_shape)
     if padfree and z_only:
@@ -486,6 +509,7 @@ def make_sharded_temporal_step(
     k: int,
     interpret: Optional[bool] = None,
     periodic: bool = False,
+    kind: Optional[str] = None,
 ):
     """Temporal blocking under decomposition, any dimensionality.
 
@@ -494,12 +518,13 @@ def make_sharded_temporal_step(
     (cli --fuse --mesh, benchmarks/scaling.py --fuse) that should not
     care which kernel shape implements the k-steps-per-exchange strategy.
     Returns None when the (stencil, mesh, shape, k) combination is
-    unsupported by the applicable builder.
+    unsupported by the applicable builder.  ``kind="stream"`` (3D,
+    z-only meshes) forces the sliding-window streaming kernel.
     """
     if stencil.ndim == 2:
-        return make_sharded_fullgrid_step(
+        return None if kind else make_sharded_fullgrid_step(
             stencil, mesh, global_shape, k, interpret=interpret,
             periodic=periodic)
     return make_sharded_fused_step(
         stencil, mesh, global_shape, k, interpret=interpret,
-        periodic=periodic)
+        periodic=periodic, kind=kind)

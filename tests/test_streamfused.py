@@ -89,14 +89,17 @@ def test_wave3d_two_fields():
     _equiv("wave3d", (24, 32, 128), 4)
 
 
+@pytest.mark.slow
 def test_grayscott3d_coupled_fields():
     _equiv("grayscott3d", (24, 32, 128), 4)
 
 
+@pytest.mark.slow
 def test_advect3d():
     _equiv("advect3d", (24, 32, 128), 4)
 
 
+@pytest.mark.slow
 def test_heat3d27():
     _equiv("heat3d27", (24, 32, 128), 4)
 
@@ -116,3 +119,67 @@ def test_declines_2d_and_unknown():
                                   interpret=True) is None
     assert make_stream_fused_step(make_stencil("life"), (64, 64), 4,
                                   interpret=True) is None
+
+
+def _sharded_equiv(name, grid, mesh_shape, k, steps=None, **kw):
+    from mpi_cuda_process_tpu import make_mesh, shard_fields
+    from mpi_cuda_process_tpu.parallel.stepper import make_sharded_fused_step
+
+    st = make_stencil(name, **kw)
+    fields = init_state(st, grid, seed=9, kind="pulse")
+    ref = fields
+    step = jax.jit(make_step(st, grid))
+    n = steps or k
+    for _ in range(n):
+        ref = step(ref)
+    mesh = make_mesh(mesh_shape)
+    stream = make_sharded_fused_step(st, mesh, grid, k, interpret=True,
+                                     kind="stream")
+    assert stream is not None, f"sharded stream declined {name} {grid}"
+    got = shard_fields(fields, mesh, 3)
+    run = jax.jit(stream)
+    for _ in range(n // k):
+        got = run(got)
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=0, atol=1e-4)
+
+
+def test_sharded_stream_matches_unsharded():
+    """z-decomposed streaming (slab operands, global-origin frame) must
+    match the unsharded plain run — the config-5 execution candidate."""
+    _sharded_equiv("heat3d", (48, 32, 128), (2, 1, 1), 4)
+
+
+def test_sharded_stream_two_passes():
+    # slab values must be re-exchanged between passes
+    _sharded_equiv("heat3d", (48, 32, 128), (2, 1, 1), 4, steps=8)
+
+
+@pytest.mark.slow
+def test_sharded_stream_four_shards():
+    _sharded_equiv("heat3d", (96, 32, 128), (4, 1, 1), 4)
+
+
+@pytest.mark.slow
+def test_sharded_stream_wave_two_fields():
+    _sharded_equiv("wave3d", (48, 32, 128), (2, 1, 1), 4)
+
+
+@pytest.mark.slow
+def test_sharded_stream_sor_parity():
+    # wm = 2k: global parity must stay consistent across shard origins
+    _sharded_equiv("sor3d", (96, 32, 128), (2, 1, 1), 4)
+
+
+def test_sharded_stream_declines_y_mesh_and_periodic():
+    from mpi_cuda_process_tpu import make_mesh
+    from mpi_cuda_process_tpu.parallel.stepper import make_sharded_fused_step
+
+    st = make_stencil("heat3d")
+    assert make_sharded_fused_step(
+        st, make_mesh((1, 2, 1)), (48, 64, 128), 4, interpret=True,
+        kind="stream") is None
+    assert make_sharded_fused_step(
+        st, make_mesh((2, 1, 1)), (48, 32, 128), 4, interpret=True,
+        kind="stream", periodic=True) is None
