@@ -77,7 +77,7 @@ def _time_run(run, fields, reps: int) -> float:
 
 
 def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
-                 fuse=0):
+                 fuse=0, fuse_kind=None):
     import jax
 
     from mpi_cuda_process_tpu import (
@@ -97,7 +97,8 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
                 make_sharded_temporal_step,
             )
 
-            step = make_sharded_temporal_step(st, mesh, global_shape, fuse)
+            step = make_sharded_temporal_step(st, mesh, global_shape, fuse,
+                                              kind=fuse_kind)
             if step is None:
                 return None
             step_unit = fuse
@@ -110,6 +111,12 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
             )
 
             step = make_fullgrid_step(st, global_shape, fuse)
+        elif fuse_kind == "stream":
+            from mpi_cuda_process_tpu.ops.pallas.streamfused import (
+                make_stream_fused_step,
+            )
+
+            step = make_stream_fused_step(st, global_shape, fuse)
         else:
             from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
 
@@ -197,6 +204,12 @@ def main(argv=None) -> int:
                    help="use the explicit interior/boundary overlap stepper "
                         "(weak/strong modes) — compare against the default "
                         "XLA-scheduled exchange")
+    p.add_argument("--fuse-kind", default=None,
+                   choices=["stream"],
+                   help="force the streaming (sliding-window manual-DMA) "
+                        "kernel for --fuse rungs — A/B vs the default "
+                        "zslab/windowed kernels (virtual meshes: relative "
+                        "evidence only)")
     p.add_argument("--fuse", type=int, default=0,
                    help="temporal blocking: k fused micro-steps per "
                         "width-k exchange (weak/strong modes; meshes keep "
@@ -260,7 +273,7 @@ def main(argv=None) -> int:
                 continue
         got = bench_config(
             st, mesh_shape, global_shape, a.steps, a.reps,
-            overlap=a.overlap, fuse=a.fuse)
+            overlap=a.overlap, fuse=a.fuse, fuse_kind=a.fuse_kind)
         if got is None:
             print(f"[scaling] skip {mesh_shape}: untileable fused "
                   f"k={a.fuse}", file=sys.stderr)
@@ -275,6 +288,7 @@ def main(argv=None) -> int:
         rec = {
             "mode": a.mode, "stencil": a.stencil,
             "overlap": a.overlap, "fuse": a.fuse,
+            "fuse_kind": a.fuse_kind,
             "mesh": list(mesh_shape), "grid": list(global_shape),
             "mcells_per_s": round(mcells, 1),
             "mcells_per_s_per_device": round(per_dev, 1),
