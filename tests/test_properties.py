@@ -480,3 +480,41 @@ def test_zslab_padfree_sharded_property(case, nz, lz, y, k, periodic, seed):
         np.testing.assert_allclose(
             np.asarray(g, np.float32), np.asarray(r, np.float32),
             rtol=0, atol=1e-3)
+
+
+@pytest.mark.slow
+@settings(max_examples=8, **_SETTINGS)
+@given(
+    case=hs.sampled_from(_PALLAS_CASES),
+    zchunks=hs.integers(3, 5),
+    bz=hs.sampled_from([8, 16]),
+    y=hs.sampled_from([24, 32, 48]),
+    k=hs.sampled_from([2, 4]),
+    seed=hs.integers(0, 2**16),
+)
+def test_stream_builder_declines_or_matches(case, zchunks, bz, y, k, seed):
+    """Free-shape sweep of the STREAMING kernel's gates: for any shape the
+    builder either declines (caller falls back) or produces a step that
+    matches k plain steps — never a silently-wrong geometry.  The gates
+    under test interact: bz >= 2*k*halo*phases, >= 3 chunks, sublane
+    alignment of the y strip, and the rounded margin clamp wm_a <= Y."""
+    from mpi_cuda_process_tpu.ops.pallas.streamfused import (
+        make_stream_fused_step,
+    )
+
+    name, kw = case
+    st = make_stencil(name, **kw)
+    grid = (zchunks * bz, y, 128)
+    stream = make_stream_fused_step(st, grid, k, interpret=True)
+    if stream is None:
+        return
+    fields = init_state(st, grid, seed=seed, density=0.3, kind="auto")
+    ref = fields
+    step = make_step(st, grid)
+    for _ in range(k):
+        ref = step(ref)
+    got = stream(fields)
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(r, np.float32),
+            rtol=0, atol=1e-3)
