@@ -20,6 +20,14 @@ LOG="${1:-/tmp/watch_tunnel.log}"
 echo "[watch] start $(date -u +%H:%M:%S)" >> "$LOG"
 while :; do
   if timeout 120 python -c "import jax, jax.numpy as jnp; print(float(jnp.ones((8,8)).sum()))" >/dev/null 2>&1; then
+    # bench FIRST: ~5 min on proven-compile-class kernels, so the round
+    # has a fresh local headline even if the campaign later re-wedges
+    # the tunnel on a new compile (2026-07-31: recovery lasted ~25 min
+    # before a killed padfree compile re-wedged it).
+    if [ ! -f .bench_cache.json ]; then
+      echo "[watch] probe OK $(date -u +%H:%M:%S) — bench first (no local cache)" >> "$LOG"
+      timeout 1200 python bench.py >> "${LOG%.log}.bench.log" 2>&1
+    fi
     echo "[watch] probe OK $(date -u +%H:%M:%S) — draining campaign" >> "$LOG"
     python benchmarks/measure.py >> "${LOG%.log}.measure.log" 2>&1
     left=$(python - <<'EOF'
