@@ -68,6 +68,30 @@ def test_padfree_kernel_compiles_and_matches_on_chip():
         np.asarray(out[0]), np.asarray(ref[0]), rtol=0, atol=1e-4)
 
 
+def test_stream_kernel_compiles_and_matches_on_chip():
+    """The round-4 STREAMING kernel (manual DMA pipeline: run_scoped +
+    make_async_copy + ANY refs) through the REAL Mosaic compile — the
+    newest compile class; proving it at tiny size de-risks the campaign's
+    *_stream4/8 labels."""
+    from mpi_cuda_process_tpu import init_state, make_step, make_stencil
+    from mpi_cuda_process_tpu.ops.pallas.streamfused import (
+        make_stream_fused_step,
+    )
+
+    st = make_stencil("heat3d")
+    shape = (64, 64, 128)
+    fields = init_state(st, shape, seed=3, kind="pulse")
+    ref = fields
+    step = jax.jit(make_step(st, shape))
+    for _ in range(4):
+        ref = step(ref)
+    stream = make_stream_fused_step(st, shape, 4, interpret=False)
+    assert stream is not None
+    out = jax.jit(stream)(fields)
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.asarray(ref[0]), rtol=0, atol=1e-4)
+
+
 def test_life_render_on_chip(capsys):
     from mpi_cuda_process_tpu.cli import config_from_args, run
 
