@@ -186,6 +186,13 @@ _AUTO_FUSE_K_BF16: dict = {}
 # 2048^2 jnp = 53.8 Gcells/s is the number to beat); flipping a family is
 # then a one-line data change here.
 _AUTO_FULL_K: dict = {}
+# Streaming (sliding-window manual-DMA) kernel kind per family
+# (ops/pallas/streamfused.py): EMPTY until the campaign's *_stream4/8
+# labels land a measured win over the tiled kernels (heat3d 512^3 fused4
+# = 107.3 Gcells/s is the number to beat; the projection says ~155).
+# Flipping a family routes its auto-fuse upgrade through
+# --fuse-kind stream; until then stream runs only when explicit.
+_AUTO_FUSE_KIND: dict = {}
 
 
 def _uses_mesh(cfg: RunConfig) -> bool:
@@ -212,6 +219,11 @@ def maybe_auto_fuse(cfg: RunConfig) -> RunConfig:
     ``run``'s auto-retry, which re-runs the whole config on the jnp path.
     """
     if cfg.compute != "auto" or cfg.fuse:
+        return cfg
+    if cfg.fuse_kind != "auto":
+        # a user-forced kind without --fuse must reach build()'s
+        # "--fuse-kind requires an explicit --fuse K" guard, not be
+        # upgraded into a kernel the auto probe never checked
         return cfg
     if jax.default_backend() != "tpu":
         return cfg
@@ -245,6 +257,19 @@ def maybe_auto_fuse(cfg: RunConfig) -> RunConfig:
             return cfg  # unaligned extents / over the VMEM budget
         log.info("auto: temporal blocking k=%d (whole-grid VMEM kernel)", k)
     else:
+        kind = _AUTO_FUSE_KIND.get(cfg.stencil)
+        if kind == "stream":
+            from .ops.pallas.streamfused import make_stream_fused_step
+
+            # probe the exact kernel build() will construct for the
+            # forced kind (no fallback there — an unprobed upgrade would
+            # turn auto into a hard error)
+            if make_stream_fused_step(st, cfg.grid, k) is not None:
+                log.info("auto: temporal blocking k=%d (streaming "
+                         "Pallas kernel)", k)
+                return dataclasses.replace(cfg, fuse=k, fuse_kind="stream")
+            # stream untileable for this shape: fall through to the
+            # tiled probes below (auto never hard-errors)
         from .ops.pallas.fused import make_fused_step, prefer_padfree
 
         # probe the same variants build() will construct (pad-free above

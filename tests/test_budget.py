@@ -124,6 +124,25 @@ def test_stream_kind_has_no_transient_and_probes_buildability():
     assert any("UNBUILDABLE" in label for label, _ in parts2)
 
 
+def test_config5_stream_envelope_single_field_yes_wave_no():
+    """Builder-verified config-5 streaming envelope (docs/STATE.md): at
+    the local shape 64x4096x4096 (4096^3 on 64x1x1), single-field
+    families tile; two-field wave3d's whole-lane strips exceed the VMEM
+    gate and must DECLINE (config-5 wave stays on the wide-X zslab
+    kernel) — a silent admit here would compile-OOM a real slice."""
+    from mpi_cuda_process_tpu.ops.pallas.streamfused import (
+        build_stream_sharded_call,
+    )
+
+    local, g5 = (64, 4096, 4096), (4096, 4096, 4096)
+    st = make_stencil("heat3d")
+    assert build_stream_sharded_call(st, local, g5, 4,
+                                     interpret=True) is not None
+    wave = make_stencil("wave3d")
+    assert build_stream_sharded_call(wave, local, g5, 4,
+                                     interpret=True) is None
+
+
 def test_forced_padfree_never_estimates_the_padded_transient():
     """fuse_kind='padfree' has no padded fallback in cli.build — the
     estimate must not charge padded-transient bytes the run would never

@@ -359,6 +359,39 @@ def test_auto_fuse_policy_table(monkeypatch):
         RunConfig(stencil="heat3d", grid=(16, 16, 128), iters=6)).fuse == 0
 
 
+def test_auto_fuse_kind_table(monkeypatch):
+    """A family flipped into _AUTO_FUSE_KIND routes its auto upgrade
+    through the streaming kernel — probing the EXACT kernel build() will
+    construct, with a tiled fallback when stream declines the shape."""
+    from mpi_cuda_process_tpu import cli
+    from mpi_cuda_process_tpu.ops.pallas import fused, streamfused
+
+    monkeypatch.setattr(cli.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(fused, "_interpret_default", lambda: True)
+    monkeypatch.setattr(streamfused, "_interpret_default", lambda: True)
+    monkeypatch.setattr(cli, "_AUTO_FUSE_KIND", {"heat3d": "stream"})
+    # streamable shape: upgrade carries the kind
+    got = cli.maybe_auto_fuse(
+        RunConfig(stencil="heat3d", grid=(24, 32, 128), iters=8))
+    assert (got.fuse, got.fuse_kind) == (4, "stream")
+    # stream-untileable shape (two z chunks): falls back to the tiled
+    # upgrade instead of hard-erroring in build()
+    got = cli.maybe_auto_fuse(
+        RunConfig(stencil="heat3d", grid=(16, 16, 128), iters=8))
+    assert (got.fuse, got.fuse_kind) == (4, "auto")
+    # empty table (the shipped default): kind never set by auto
+    monkeypatch.setattr(cli, "_AUTO_FUSE_KIND", {})
+    got = cli.maybe_auto_fuse(
+        RunConfig(stencil="heat3d", grid=(24, 32, 128), iters=8))
+    assert (got.fuse, got.fuse_kind) == (4, "auto")
+    # a user-forced kind WITHOUT --fuse is never auto-upgraded: it must
+    # reach build()'s "--fuse-kind requires an explicit --fuse K" guard
+    got = cli.maybe_auto_fuse(RunConfig(
+        stencil="heat3d", grid=(24, 32, 128), iters=8,
+        fuse_kind="stream"))
+    assert (got.fuse, got.fuse_kind) == (0, "stream")
+
+
 def test_tol_composes_with_fuse():
     """--tol + --fuse: convergence inside the while_loop, k steps per call."""
     base = dict(stencil="sor2d", grid=(16, 128), init="zero")
