@@ -47,8 +47,14 @@ def _parse_kspec(spec):
     """
     if "@" in spec:
         k, t = spec.split("@", 1)
-        bz, by = t.split("x")
-        return int(k), (int(bz), int(by))
+        # 2-tuple (bz, by) for the tiled/padfree kernels; the streaming
+        # kernel also accepts a 3rd x-window extent (streamK@BZxBYxBX).
+        # Arity is validated HERE so a malformed spec fails at the input
+        # boundary, not as an unpack error deep in a kernel builder.
+        tiles = tuple(int(v) for v in t.split("x"))
+        if len(tiles) not in (2, 3):
+            raise ValueError(f"tile spec {t!r}: want BZxBY or BZxBYxBX")
+        return int(k), tiles
     return int(spec), None
 
 
@@ -88,6 +94,8 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
         # pad-free 9-block raw-grid temporal blocking (no pad transient)
         from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
         step_unit, tiles = _parse_kspec(compute[len("padfree"):])
+        if tiles is not None and len(tiles) != 2:
+            raise ValueError("tiled kernels take 2 tile extents (BZxBY)")
         step = make_fused_step(st, grid, step_unit, tiles=tiles,
                                padfree=True)
         if step is None:
@@ -105,6 +113,8 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
     elif compute.startswith("fused"):
         from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
         step_unit, tiles = _parse_kspec(compute[len("fused"):])
+        if tiles is not None and len(tiles) != 2:
+            raise ValueError("tiled kernels take 2 tile extents (BZxBY)")
         step = make_fused_step(st, grid, step_unit, tiles=tiles)
         if step is None:
             raise ValueError(f"untileable fused k={step_unit} for {grid}")

@@ -59,6 +59,7 @@ from ..stencil import Fields, Stencil
 from .kernels import _VMEM_LIMIT_BYTES, _interpret_default
 from .fused import (
     _MICRO,
+    _XWIN_GX,
     _halo_per_micro,
     _lane_round,
     _run_micros,
@@ -71,12 +72,18 @@ _VMEM_LIMIT = int(_VMEM_LIMIT_BYTES * 0.8)
 # Ring slots.  4 = the minimum that lets chunk c+2 prefetch while chunks
 # {c-1, c, c+1} stay resident for the current window.
 _NSLOTS = 4
+# Lane-axis shell for x-windowed strips: one lane tile per side (the
+# minimum DMA-alignable x offset granularity), >= every family's temporal
+# margin wm (gated) so roll-wrap garbage never reaches the stored core —
+# the SAME invariant as the wide-X tiled kernel's shell, so the single
+# definition is shared.
+_XSHELL = _XWIN_GX
 
 
-def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, lshape,
+def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
                  gshape, parity, origin_z, ins, outs, slabs):
-    """One y strip: slide the z window down the local block, k micro-steps
-    per chunk.
+    """One (y, x) strip: slide the z window down the local block, k
+    micro-steps per chunk.
 
     ``lshape`` is the LOCAL (Lz, Y, X); ``gshape`` the global shape the
     frame mask is derived against, with ``origin_z`` this block's global
@@ -86,6 +93,14 @@ def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, lshape,
     field holding the exchanged neighbor slabs (sharded: edge chunks
     substitute slab planes for the clamped overhang, so the window sees
     genuine neighbor values).
+
+    ``bx`` is None for whole-lane strips (the x axis never sliced — the
+    original kernel, byte-identical) or a lane-tile multiple: windows
+    then carry a ``_XSHELL``-lane x shell, clamped at the (always-global)
+    x walls exactly like y; lane-roll wrap garbage lands in the shell,
+    which temporal validity excludes (``_XSHELL >= wm``, gated).  This is
+    what fits two-field wave at X=4096 lanes (config 5) where whole-lane
+    strips exceed VMEM.
     """
     Lz, Y, X = lshape
     nc = Lz // bz
@@ -93,19 +108,29 @@ def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, lshape,
     wy = by + 2 * wm_a
     yj = pl.program_id(0)
     ylo = jnp.clip(yj * by - wm_a, 0, Y - wy)
+    if bx is None:
+        wx, xlo, x_idx = X, 0, ()
+        store_x, out_x = 0, ()
+    else:
+        wx = bx + 2 * _XSHELL
+        xj = pl.program_id(1)
+        xlo = jnp.clip(xj * bx - _XSHELL, 0, X - wx)
+        x_idx = (pl.ds(xlo, wx),)
+        store_x, out_x = xj * bx - xlo, (pl.ds(xj * bx, bx),)
 
     def body(scratch, sems, slab_mem=None, slab_sems=None):
         def dma(f, chunk):
             slot = jax.lax.rem(chunk, _NSLOTS) if _traced(chunk) \
                 else chunk % _NSLOTS
             return pltpu.make_async_copy(
-                ins[f].at[pl.ds(chunk * bz, bz), pl.ds(ylo, wy)],
+                ins[f].at[(pl.ds(chunk * bz, bz), pl.ds(ylo, wy))
+                          + x_idx],
                 scratch.at[f, pl.ds(slot * bz, bz)],
                 sems.at[f, slot])
 
         def slab_dma(f, side):
             return pltpu.make_async_copy(
-                slabs[f][side].at[:, pl.ds(ylo, wy)],
+                slabs[f][side].at[(slice(None), pl.ds(ylo, wy)) + x_idx],
                 slab_mem.at[f, side],
                 slab_sems.at[f, side])
 
@@ -188,14 +213,15 @@ def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, lshape,
             else:
                 z0 = origin_z + zlo
                 store_z = c * bz - zlo if not _traced(c) else wm
-            frame, extra = _window_frame((wz, wy, X), z0, ylo, gshape,
-                                         halo, False, parity)
+            frame, extra = _window_frame((wz, wy, wx), z0, ylo, gshape,
+                                         halo, False, parity, x0=xlo)
             fields = _run_micros(micro, fields, frame, extra, k)
             for f in range(nfields):
-                outs[f][pl.ds(c * bz, bz), pl.ds(yj * by, by)] = (
+                outs[f][(pl.ds(c * bz, bz), pl.ds(yj * by, by))
+                        + out_x] = (
                     jax.lax.dynamic_slice(
-                        fields[f], (store_z, yj * by - ylo, 0),
-                        (bz, by, X)))
+                        fields[f], (store_z, yj * by - ylo, store_x),
+                        (bz, by, bx if bx is not None else X)))
 
         process(0, True, False)
         jax.lax.fori_loop(
@@ -203,11 +229,11 @@ def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, lshape,
         process(nc - 1, False, True)
 
     kwargs = dict(
-        scratch=pltpu.VMEM((nfields, _NSLOTS * bz, wy, X), ins[0].dtype),
+        scratch=pltpu.VMEM((nfields, _NSLOTS * bz, wy, wx), ins[0].dtype),
         sems=pltpu.SemaphoreType.DMA((nfields, _NSLOTS)),
     )
     if slabs is not None:
-        kwargs["slab_mem"] = pltpu.VMEM((nfields, 2, wm, wy, X),
+        kwargs["slab_mem"] = pltpu.VMEM((nfields, 2, wm, wy, wx),
                                         ins[0].dtype)
         kwargs["slab_sems"] = pltpu.SemaphoreType.DMA((nfields, 2))
     pl.run_scoped(body, **kwargs)
@@ -217,15 +243,15 @@ def _traced(v) -> bool:
     return not isinstance(v, int)
 
 
-def _stream_kernel(micro, nfields, k, halo, wm, wm_a, bz, by, shape,
+def _stream_kernel(micro, nfields, k, halo, wm, wm_a, bz, by, bx, shape,
                    parity, *refs):
     """Unsharded wrapper: ``refs`` = nfields input HBM refs then nfields
     output HBM refs (whole arrays, ``memory_space=ANY``)."""
-    _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, shape, shape,
-                 parity, 0, refs[:nfields], refs[nfields:], None)
+    _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, shape,
+                 shape, parity, 0, refs[:nfields], refs[nfields:], None)
 
 
-def _stream_sharded_kernel(micro, nfields, k, halo, wm, wm_a, bz, by,
+def _stream_sharded_kernel(micro, nfields, k, halo, wm, wm_a, bz, by, bx,
                            lshape, gshape, parity, *refs):
     """Sharded wrapper: ``refs`` = origins (SMEM int32 (2,)), then per
     field [core, slab_lo, slab_hi] HBM refs, then nfields outputs."""
@@ -233,15 +259,21 @@ def _stream_sharded_kernel(micro, nfields, k, halo, wm, wm_a, bz, by,
     ins = [refs[3 * f] for f in range(nfields)]
     slabs = [(refs[3 * f + 1], refs[3 * f + 2]) for f in range(nfields)]
     outs = refs[3 * nfields:]
-    _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, lshape,
+    _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
                  gshape, parity, origins[0], ins, outs, slabs)
 
 
 def _pick_strip(Z, Y, X, wm, wm_a, itemsize, nfields, sharded=False):
-    """Choose (bz, by): Z/Y divisors meeting the sliding-window gates and
-    the VMEM budget.  Score: least y read amplification, then largest z
-    chunk (fewer ring warm-ups and sem ops per pass)."""
+    """Choose (bz, by, bx): Z/Y/X divisors meeting the sliding-window
+    gates and the VMEM budget.  ``bx`` is None for whole-lane strips
+    (preferred: no x amplification) or a lane-tile multiple when whole
+    rows exceed VMEM (two-field wave at X=4096 — config 5).  Score:
+    least total read amplification, then largest z chunk (fewer ring
+    warm-ups and sem ops per pass)."""
     budget_item = max(itemsize, 4)  # bf16 budgeted at the f32 envelope
+    x_options = [None] + [
+        c for c in (2048, 1024, 512, 256)
+        if X % c == 0 and c + 2 * _XSHELL <= X]
     best = None
     for bz in (32, 16, 8):
         if Z % bz or 2 * wm > bz or Z // bz < 3:
@@ -252,23 +284,38 @@ def _pick_strip(Z, Y, X, wm, wm_a, itemsize, nfields, sharded=False):
             wy = by + 2 * wm_a
             if wy > Y:
                 continue
-            wz = bz + 2 * wm
-            lane = _lane_round(X)
-            strip = wy * lane * budget_item
-            # ring + 3-chunk concat + window with ~3 live micro
-            # temporaries + the store slice
-            live = (_NSLOTS * bz * strip + 3 * bz * strip
-                    + 4 * wz * strip + bz * strip) * nfields
-            if sharded:
-                # the slab ring (both sides, every field) + the edge
-                # chunks' splice-concat temporary
-                live += (2 * 2 * wm * strip + wz * strip) * nfields
-            if live > _VMEM_LIMIT:
-                continue
-            score = (-(wy / by), bz, by)
-            if best is None or score > best[0]:
-                best = (score, (bz, by))
+            for bx in x_options:
+                wx = X if bx is None else bx + 2 * _XSHELL
+                x_amp = 1.0 if bx is None else wx / bx
+                live = _strip_live_bytes(bz, by, bx, X, wm, wm_a,
+                                         budget_item, nfields, sharded)
+                if live > _VMEM_LIMIT:
+                    continue
+                score = (-(wy / by) * x_amp, bx is None, bz, by)
+                if best is None or score > best[0]:
+                    best = (score, (bz, by, bx))
     return best[1] if best else None
+
+
+def _strip_live_bytes(bz, by, bx, X, wm, wm_a, budget_item, nfields,
+                      sharded):
+    """Scoped-VMEM live-set model for one strip program — the single
+    definition used by both the picker and explicit-tile validation (an
+    unvalidated explicit tile was the round-4 silently-wrong-geometry
+    lesson: a 'fits' must never admit a config the kernel can't host)."""
+    wz = bz + 2 * wm
+    wy = by + 2 * wm_a
+    wx = X if bx is None else bx + 2 * _XSHELL
+    strip = wy * _lane_round(wx) * budget_item
+    # ring + 3-chunk concat + window with ~3 live micro temporaries +
+    # the store slice
+    live = (_NSLOTS * bz * strip + 3 * bz * strip
+            + 4 * wz * strip + bz * strip) * nfields
+    if sharded:
+        # the slab ring (both sides, every field) + the edge chunks'
+        # splice-concat temporary
+        live += (2 * 2 * wm * strip + wz * strip) * nfields
+    return live
 
 
 def stream_supported(stencil: Stencil) -> bool:
@@ -276,7 +323,9 @@ def stream_supported(stencil: Stencil) -> bool:
 
 
 def _stream_gates(stencil, Lz, Y, X, k, tiles, sharded=False):
-    """Shared builder gates; returns (bz, by, wm, wm_a, ...) or None."""
+    """Shared builder gates; returns
+    ``(micro_factory, halo, nfields, wm, wm_a, bz, by, bx)`` or None —
+    ``bx`` is None for whole-lane strips, else the x-window extent."""
     micro_factory, halo, nfields = _MICRO[stencil.name]
     wm = k * _halo_per_micro(stencil)
     itemsize = jnp.dtype(stencil.dtype).itemsize
@@ -287,11 +336,22 @@ def _stream_gates(stencil, Lz, Y, X, k, tiles, sharded=False):
                             sharded=sharded)
         if tiles is None:
             return None
-    bz, by = tiles
+    if len(tiles) == 2:
+        bz, by = tiles
+        bx = None
+    else:
+        bz, by, bx = tiles
     if (Lz % bz or Y % by or 2 * wm > bz or Lz // bz < 3
             or by % sub or by + 2 * wm_a > Y):
         return None
-    return micro_factory, halo, nfields, wm, wm_a, bz, by
+    if bx is not None and (X % bx or bx % _XSHELL
+                           or bx + 2 * _XSHELL > X or wm > _XSHELL):
+        return None
+    # explicit tiles go through the SAME live-set gate as the picker
+    if _strip_live_bytes(bz, by, bx, X, wm, wm_a, max(itemsize, 4),
+                         nfields, sharded) > _VMEM_LIMIT:
+        return None
+    return micro_factory, halo, nfields, wm, wm_a, bz, by, bx
 
 
 def build_stream_sharded_call(
@@ -299,7 +359,7 @@ def build_stream_sharded_call(
     local_shape: Tuple[int, int, int],
     global_shape: Tuple[int, int, int],
     k: int,
-    tiles: Optional[Tuple[int, int]] = None,
+    tiles: Optional[Tuple[int, ...]] = None,  # (bz, by[, bx])
     interpret: Optional[bool] = None,
     periodic: bool = False,
 ):
@@ -331,17 +391,18 @@ def build_stream_sharded_call(
     gates = _stream_gates(stencil, Lz, Y, X, k, tiles, sharded=True)
     if gates is None:
         return None
-    micro_factory, halo, nfields, wm, wm_a, bz, by = gates
+    micro_factory, halo, nfields, wm, wm_a, bz, by, bx = gates
     micro = micro_factory(stencil, interpret)
     parity = bool(stencil.phases)
 
     def kernel(*refs):
         _stream_sharded_kernel(micro, nfields, k, halo, wm, wm_a, bz, by,
-                               (Lz, Y, X), gshape, parity, *refs)
+                               bx, (Lz, Y, X), gshape, parity, *refs)
 
+    grid = (Y // by,) if bx is None else (Y // by, X // bx)
     call = pl.pallas_call(
         kernel,
-        grid=(Y // by,),
+        grid=grid,
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
         + [pl.BlockSpec(memory_space=pl.ANY)] * (3 * nfields),
         out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * nfields,
@@ -350,7 +411,7 @@ def build_stream_sharded_call(
         interpret=interpret,
         compiler_params=None if interpret else pltpu.CompilerParams(
             vmem_limit_bytes=_VMEM_LIMIT_BYTES,
-            dimension_semantics=("arbitrary",)),
+            dimension_semantics=("arbitrary",) * len(grid)),
     )
     return call, wm, nfields
 
@@ -359,7 +420,7 @@ def make_stream_fused_step(
     stencil: Stencil,
     global_shape: Sequence[int],
     k: int,
-    tiles: Optional[Tuple[int, int]] = None,
+    tiles: Optional[Tuple[int, ...]] = None,  # (bz, by[, bx])
     interpret: Optional[bool] = None,
 ):
     """Build ``fields -> fields`` advancing ``k`` steps in one streaming
@@ -378,17 +439,18 @@ def make_stream_fused_step(
     gates = _stream_gates(stencil, Z, Y, X, k, tiles)
     if gates is None:
         return None
-    micro_factory, halo, nfields, wm, wm_a, bz, by = gates
+    micro_factory, halo, nfields, wm, wm_a, bz, by, bx = gates
     micro = micro_factory(stencil, interpret)
     parity = bool(stencil.phases)
 
     def kernel(*refs):
-        _stream_kernel(micro, nfields, k, halo, wm, wm_a, bz, by,
+        _stream_kernel(micro, nfields, k, halo, wm, wm_a, bz, by, bx,
                        (Z, Y, X), parity, *refs)
 
+    grid = (Y // by,) if bx is None else (Y // by, X // bx)
     call = pl.pallas_call(
         kernel,
-        grid=(Y // by,),
+        grid=grid,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * nfields,
         out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * nfields,
         out_shape=[jax.ShapeDtypeStruct((Z, Y, X), stencil.dtype)
@@ -396,7 +458,7 @@ def make_stream_fused_step(
         interpret=interpret,
         compiler_params=None if interpret else pltpu.CompilerParams(
             vmem_limit_bytes=_VMEM_LIMIT_BYTES,
-            dimension_semantics=("arbitrary",)),
+            dimension_semantics=("arbitrary",) * len(grid)),
     )
 
     def step_k(fields: Fields) -> Fields:

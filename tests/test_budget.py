@@ -124,13 +124,15 @@ def test_stream_kind_has_no_transient_and_probes_buildability():
     assert any("UNBUILDABLE" in label for label, _ in parts2)
 
 
-def test_config5_stream_envelope_single_field_yes_wave_no():
+def test_config5_stream_envelope_builder_verified():
     """Builder-verified config-5 streaming envelope (docs/STATE.md): at
     the local shape 64x4096x4096 (4096^3 on 64x1x1), single-field
-    families tile; two-field wave3d's whole-lane strips exceed the VMEM
-    gate and must DECLINE (config-5 wave stays on the wide-X zslab
-    kernel) — a silent admit here would compile-OOM a real slice."""
+    families tile whole-lane; two-field wave3d exceeds the whole-lane
+    VMEM gate but tiles via an X-WINDOWED strip (~1.9x read amp vs the
+    wide-X tiled kernel's 4.5x).  The picker must never admit a config
+    the kernel can't host — a silent admit would compile-OOM a slice."""
     from mpi_cuda_process_tpu.ops.pallas.streamfused import (
+        _stream_gates,
         build_stream_sharded_call,
     )
 
@@ -140,6 +142,11 @@ def test_config5_stream_envelope_single_field_yes_wave_no():
                                      interpret=True) is not None
     wave = make_stencil("wave3d")
     assert build_stream_sharded_call(wave, local, g5, 4,
+                                     interpret=True) is not None
+    gates = _stream_gates(wave, 64, 4096, 4096, 4, None, sharded=True)
+    assert gates[7] is not None  # wave needs the x window (bx set)
+    # whole-lane tiles forced for wave at this shape must still decline
+    assert build_stream_sharded_call(wave, local, g5, 4, tiles=(8, 16),
                                      interpret=True) is None
 
 

@@ -32,6 +32,8 @@ def test_parse_kspec(M):
     assert M._parse_kspec("16") == (16, None)
     assert M._parse_kspec("4@16x16") == (4, (16, 16))
     assert M._parse_kspec("8@32x16") == (8, (32, 16))
+    # streaming kernels take an optional 3rd x-window extent
+    assert M._parse_kspec("4@8x16x256") == (4, (8, 16, 256))
 
 
 def test_labels_unique_and_risky_derived(M):
