@@ -400,24 +400,32 @@ def build(cfg: RunConfig):
         if cfg.compute == "pallas" or cfg.overlap:
             raise ValueError("--fuse replaces the whole step; it excludes "
                              "--compute pallas and --overlap")
-        if cfg.fuse_kind != "auto" and (use_mesh or st.ndim == 2):
+        if cfg.fuse_kind != "auto" and (
+                st.ndim == 2
+                or (use_mesh and cfg.fuse_kind != "stream")):
             raise ValueError(
-                "--fuse-kind selects among the UNSHARDED 3D kernels; "
-                "sharded runs use the exchange-composed kernels and 2D "
-                "grids the whole-grid VMEM kernel (leave it 'auto')")
+                "--fuse-kind selects the 3D kernel variant; 2D grids use "
+                "the whole-grid VMEM kernel, and sharded runs support "
+                "only 'stream' (the exchange-composed tiled kernels are "
+                "'auto')")
         if use_mesh:
             # k fused steps per width-k*halo exchange (the 4096^3-class
             # configuration: decomposition AND temporal blocking); 2D
             # grids use the whole-local-block VMEM kernel under a row
             # decomposition (the reference's own 1-D split, k-amortized)
+            kind = cfg.fuse_kind if cfg.fuse_kind == "stream" else None
             fused = stepper_lib.make_sharded_temporal_step(
-                st, m, cfg.grid, cfg.fuse, periodic=cfg.periodic)
+                st, m, cfg.grid, cfg.fuse, periodic=cfg.periodic,
+                kind=kind)
             if fused is None:
                 raise ValueError(
-                    f"--fuse {cfg.fuse} + --mesh {cfg.mesh} unsupported for "
-                    f"{st.name} on {cfg.grid}: needs a fused kernel, an "
-                    f"unsharded lane axis, aligned per-shard extents, and "
-                    f"blocks >= the k-step margin")
+                    f"--fuse {cfg.fuse} + --mesh {cfg.mesh}"
+                    + (" --fuse-kind stream" if kind else "")
+                    + f" unsupported for {st.name} on {cfg.grid}: needs a "
+                    f"fused kernel, an unsharded lane axis"
+                    + (", a z-only mesh, guard-frame BCs" if kind else "")
+                    + ", aligned per-shard extents, and blocks >= the "
+                    "k-step margin")
         elif st.ndim == 2:
             # 2D grids fit VMEM whole: k steps per HBM residency, exact
             # (no windows, no alignment constraint on k)

@@ -108,8 +108,23 @@ def estimate_run_bytes(
         # kernel, and the estimate follows it (round-4 review finding:
         # "fits" must never describe an unconstructible execution).
         # Builder construction is pure Python — no compile happens here.
-        if sharded and z_only and prefer_padfree(stencil, local,
-                                                 batch=batch) \
+        if sharded and fuse_kind == "stream":
+            # slab operands only (zslab contract); the VMEM ring is not
+            # HBM.  Probe construction so a "fits" never describes an
+            # unconstructible run (cli raises before any allocation).
+            from ..ops.pallas.streamfused import build_stream_sharded_call
+
+            ok = z_only and build_stream_sharded_call(
+                stencil, local, tuple(int(g) for g in grid), fuse,
+                interpret=True, periodic=periodic) is not None
+            slab_b = batch * 2 * m * ly * lx * itemsize * nfields
+            parts.append(
+                (f"sharded streaming: slab operands only (2x{m} rows)"
+                 if ok else
+                 "sharded streaming: UNBUILDABLE for this shape (the run "
+                 "refuses before allocating)", slab_b if ok else 0))
+        elif sharded and z_only and prefer_padfree(stencil, local,
+                                                   batch=batch) \
                 and (build_zslab_padfree_call(
                     stencil, local, tuple(int(g) for g in grid), fuse,
                     interpret=True, periodic=periodic) is not None

@@ -150,6 +150,23 @@ def test_config5_stream_envelope_builder_verified():
                                      interpret=True) is None
 
 
+def test_sharded_stream_budget_slab_operands_only():
+    """--fuse-kind stream --mesh: HBM holds state + output + slab
+    operands (the VMEM ring is not HBM); config-5 wave in FULL f32 fits
+    the same envelope as the zslab kernels, now on the streaming path."""
+    st = make_stencil("wave3d")
+    total, parts = budget.check_budget(
+        st, (4096,) * 3, mesh=(64, 1, 1), fuse=4, fuse_kind="stream",
+        hbm_bytes=V5E_HBM)
+    assert any("sharded streaming: slab operands" in label
+               for label, _ in parts)
+    assert 14 * GiB < total < 15 * GiB  # 2x4 state + 4 out + 1 slabs +10%
+    # unconstructible local shape: labeled, never a silent 'fits'
+    _, parts2 = budget.estimate_run_bytes(
+        st, (64, 64, 128), mesh=(16, 1, 1), fuse=4, fuse_kind="stream")
+    assert any("UNBUILDABLE" in label for label, _ in parts2)
+
+
 def test_forced_padfree_never_estimates_the_padded_transient():
     """fuse_kind='padfree' has no padded fallback in cli.build — the
     estimate must not charge padded-transient bytes the run would never

@@ -292,6 +292,18 @@ def test_fuse_kind_padfree_matches_plain_run():
         np.asarray(pf[0]), np.asarray(plain[0]), rtol=0, atol=1e-4)
 
 
+def test_fuse_kind_stream_with_mesh_matches_plain_run():
+    """--fuse K --fuse-kind stream --mesh (z-only): the sharded streaming
+    kernel through the CLI — the config-5 command shape."""
+    base = dict(stencil="heat3d", grid=(48, 32, 128), iters=8,
+                init="random", seed=2)
+    plain, _ = run(RunConfig(**base))
+    stream, _ = run(RunConfig(**base, fuse=4, fuse_kind="stream",
+                              mesh=(2, 1, 1)))
+    np.testing.assert_allclose(
+        np.asarray(stream[0]), np.asarray(plain[0]), rtol=0, atol=1e-4)
+
+
 def test_fuse_kind_rejects_bad_configs():
     import pytest
 
@@ -302,9 +314,20 @@ def test_fuse_kind_rejects_bad_configs():
     with pytest.raises(ValueError, match="stream"):
         build(RunConfig(stencil="heat3d", grid=(24, 32, 128), iters=8,
                         fuse=4, fuse_kind="stream", ensemble=2))
-    with pytest.raises(ValueError, match="fuse-kind"):
+    # sharded stream is allowed ONLY where the builder can host it: a
+    # local block too small for the sliding window raises with the
+    # constraint list
+    with pytest.raises(ValueError, match="stream"):
         build(RunConfig(stencil="heat3d", grid=(16, 16, 128), iters=8,
                         fuse=4, fuse_kind="stream", mesh=(2, 1, 1)))
+    # y-sharded mesh: the slab-splice design is z-only
+    with pytest.raises(ValueError, match="stream"):
+        build(RunConfig(stencil="heat3d", grid=(48, 64, 128), iters=8,
+                        fuse=4, fuse_kind="stream", mesh=(1, 2, 1)))
+    # the tiled kinds stay unsharded-only
+    with pytest.raises(ValueError, match="fuse-kind"):
+        build(RunConfig(stencil="heat3d", grid=(48, 32, 128), iters=8,
+                        fuse=4, fuse_kind="padfree", mesh=(2, 1, 1)))
     with pytest.raises(ValueError, match="fuse-kind"):
         build(RunConfig(stencil="heat2d", grid=(64, 128), iters=8,
                         fuse=4, fuse_kind="tiled"))
