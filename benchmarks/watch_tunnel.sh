@@ -54,8 +54,13 @@ EOF
     echo "[watch] campaign pass done, $left runnable labels left" >> "$LOG"
     if [ "$left" = "0" ]; then
       echo "[watch] campaign drained — running bench.py" >> "$LOG"
-      python bench.py >> "${LOG%.log}.bench.log" 2>&1
-      echo "[watch] bench done; exiting $(date -u +%H:%M:%S)" >> "$LOG"
+      timeout 1200 python bench.py >> "${LOG%.log}.bench.log" 2>&1
+      # runbook step 5 LAST: the smoke tier includes the newest compile
+      # classes, and by now every campaign number is already recorded
+      echo "[watch] bench done — TPU smoke tier" >> "$LOG"
+      TPU_SMOKE=1 timeout 2400 python -m pytest tests -q -m tpu \
+        >> "${LOG%.log}.smoke.log" 2>&1
+      echo "[watch] smoke rc=$?; exiting $(date -u +%H:%M:%S)" >> "$LOG"
       exit 0
     fi
   else
