@@ -16,7 +16,12 @@ Probes (each its own label; run on a HEALTHY, otherwise-idle tunnel):
   manual2_copy   manual pipeline, 2 VMEM slots
   manual4_copy   manual pipeline, 4 slots (deeper DMA overlap)
   jnp_copy       XLA's own fused stream (the 640-710 reference point)
-  autoK_stencil / manualN_stencil_kK — the DECISIVE pair for the fused
+  manualNs_copy  store-pipelined variant: rotating OUT slots with async
+      VMEM->HBM copies too (the plain manual store is a direct write; if
+      Mosaic serializes it against the next chunk's compute, the "s"
+      variants measure faster — diagnosing whether the streaming kernel
+      needs store rotation).  Chunk auto-halved: 2N slots must fit VMEM.
+  autoK_stencil / manualN[s]_stencil_kK — the DECISIVE set for the fused
       ceiling (VERDICT r3 item 5): identical k-micro-step 5-point stencil
       compute per chunk (the fused kernels' arithmetic intensity), auto
       vs manual pipeline.  If manual streams faster AT THIS INTENSITY, a
@@ -117,8 +122,8 @@ def _manual_pipeline_kernel(nslots, bz, nchunks, transform, i_hbm, o_hbm):
                 sems.at[slot],
             )
 
-        for s in range(nslots - 1):  # warm-up: fill the pipeline
-            dma(s, s).start()
+        for s in range(min(nslots - 1, nchunks)):  # warm-up (bounded:
+            dma(s, s).start()  # tiny grids must not read past the array)
 
         def loop(chunk, _):
             slot = jax.lax.rem(chunk, nslots)
@@ -142,16 +147,13 @@ def _manual_pipeline_kernel(nslots, bz, nchunks, transform, i_hbm, o_hbm):
     )
 
 
-def _manual_pipeline(shape, dtype, bz, nslots, interpret, transform):
-    Z, Y, X = shape
-    nchunks = Z // bz
-
-    def kernel(i_hbm, o_hbm):
-        _manual_pipeline_kernel(nslots, bz, nchunks, transform, i_hbm,
-                                o_hbm)
+def _wrap_manual(shape, dtype, interpret, body_fn):
+    """The one pallas_call wrapper both manual variants share — identical
+    specs/limits so the store-pipelined vs direct-store comparison always
+    measures the same conditions."""
 
     return pl.pallas_call(
-        kernel,
+        body_fn,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         out_shape=jax.ShapeDtypeStruct(shape, dtype),
@@ -159,6 +161,88 @@ def _manual_pipeline(shape, dtype, bz, nslots, interpret, transform):
         compiler_params=None if interpret else pltpu.CompilerParams(
             vmem_limit_bytes=_VMEM_LIMIT_BYTES),
     )
+
+
+def _manual_pipeline(shape, dtype, bz, nslots, interpret, transform):
+    nchunks = shape[0] // bz
+
+    def kernel(i_hbm, o_hbm):
+        _manual_pipeline_kernel(nslots, bz, nchunks, transform, i_hbm,
+                                o_hbm)
+
+    return _wrap_manual(shape, dtype, interpret, kernel)
+
+
+def _manual_store_pipeline_kernel(nslots, bz, nchunks, transform, i_hbm,
+                                  o_hbm):
+    """Both directions pipelined: rotating load slots AND rotating store
+    slots with async VMEM->HBM copies (waited ``nslots`` chunks later).
+
+    The plain manual probes store via a direct ``o_hbm[...] = value``
+    write; if Mosaic serializes that store against the next chunk's
+    compute, these variants will measure faster — diagnosing whether the
+    streaming kernel needs store-slot rotation too.
+    """
+
+    def body(inbuf, insems, outbuf, outsems):
+        def in_dma(slot, chunk):
+            return pltpu.make_async_copy(
+                i_hbm.at[pl.ds(chunk * bz, bz)], inbuf.at[slot],
+                insems.at[slot])
+
+        def out_dma(slot, chunk):
+            return pltpu.make_async_copy(
+                outbuf.at[slot], o_hbm.at[pl.ds(chunk * bz, bz)],
+                outsems.at[slot])
+
+        for s in range(min(nslots - 1, nchunks)):  # warm-up (bounded)
+            in_dma(s, s).start()
+
+        def loop(chunk, _):
+            slot = jax.lax.rem(chunk, nslots)
+            nxt = chunk + nslots - 1
+
+            @pl.when(nxt < nchunks)
+            def _():
+                in_dma(jax.lax.rem(nxt, nslots), nxt).start()
+
+            in_dma(slot, chunk).wait()
+
+            # the store slot is reused nslots chunks later: its previous
+            # copy must have left the buffer by then
+            @pl.when(chunk >= nslots)
+            def _():
+                out_dma(slot, chunk - nslots).wait()
+
+            outbuf[slot] = transform(inbuf[slot])
+            out_dma(slot, chunk).start()
+            return ()
+
+        jax.lax.fori_loop(0, nchunks, loop, ())
+        for s in range(min(nslots, nchunks)):  # drain the last stores
+            chunk = nchunks - 1 - s
+            out_dma(chunk % nslots, chunk).wait()
+
+    pl.run_scoped(
+        body,
+        inbuf=pltpu.VMEM((nslots, bz) + tuple(i_hbm.shape[1:]),
+                         i_hbm.dtype),
+        insems=pltpu.SemaphoreType.DMA((nslots,)),
+        outbuf=pltpu.VMEM((nslots, bz) + tuple(i_hbm.shape[1:]),
+                          i_hbm.dtype),
+        outsems=pltpu.SemaphoreType.DMA((nslots,)),
+    )
+
+
+def _manual_store_pipeline(shape, dtype, bz, nslots, interpret,
+                           transform):
+    nchunks = shape[0] // bz
+
+    def kernel(i_hbm, o_hbm):
+        _manual_store_pipeline_kernel(nslots, bz, nchunks, transform,
+                                      i_hbm, o_hbm)
+
+    return _wrap_manual(shape, dtype, interpret, kernel)
 
 
 def build_probe(name, shape, dtype=jnp.float32, bz=16, interpret=None):
@@ -181,8 +265,10 @@ def build_probe(name, shape, dtype=jnp.float32, bz=16, interpret=None):
     if name.startswith("auto"):
         return _auto_pipeline(shape, dtype, bz, interpret, transform)
     if name.startswith("manual"):
-        return _manual_pipeline(shape, dtype, bz, _probe_nslots(name),
-                                interpret, transform)
+        nslots, store_pipe = _probe_nslots(name)
+        builder = (_manual_store_pipeline if store_pipe
+                   else _manual_pipeline)
+        return builder(shape, dtype, bz, nslots, interpret, transform)
     raise ValueError(f"unknown probe {name!r}")
 
 
@@ -201,17 +287,28 @@ def _probe_k(name):
 
 
 def _probe_nslots(name):
-    """VMEM slot count encoded in a manual probe's name."""
-    return int(name[len("manual"):name.index("_")])
+    """(slot count, store-pipelined?) encoded in a manual probe's name —
+    ``manual4_copy`` = 4 load slots, direct stores; ``manual4s_copy`` =
+    4 load + 4 store slots (async store copies)."""
+    spec = name[len("manual"):name.index("_")]
+    store_pipe = spec.endswith("s")
+    return int(spec.rstrip("s")), store_pipe
 
 
 PROBES = ("jnp_copy", "auto_copy", "manual2_copy", "manual4_copy",
-          "auto4_stencil", "manual2_stencil_k4", "manual4_stencil_k4")
+          "manual2s_copy", "manual4s_copy",
+          "auto4_stencil", "manual2_stencil_k4", "manual4_stencil_k4",
+          "manual4s_stencil_k4")
 
 
 def measure_probe(name, shape=(512, 512, 512), bz=16, steps=30, reps=3):
     """GB/s for one probe via the N-vs-4N scan difference (bench.py's
     dispatch-cancelling method)."""
+    if name.startswith("manual") and _probe_nslots(name)[1]:
+        # store-pipelined variants hold 2*nslots slots: halve the chunk
+        # so the scratch stays under the 100 MiB scoped-VMEM limit at
+        # the default 512^3 shape (4+4 slots x 8 MiB = 64 MiB)
+        bz = min(bz, 8)
     fn = build_probe(name, shape, bz=bz, interpret=False)
 
     def scan_n(n):

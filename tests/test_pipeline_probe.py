@@ -27,7 +27,8 @@ def probe_mod():
 
 
 @pytest.mark.parametrize("name", ["jnp_copy", "auto_copy", "manual2_copy",
-                                  "manual4_copy"])
+                                  "manual4_copy", "manual2s_copy",
+                                  "manual4s_copy"])
 def test_probe_builds_and_doubles(probe_mod, name):
     shape = (8, 8, 128)
     fn = probe_mod.build_probe(name, shape, bz=2, interpret=True)
@@ -36,7 +37,8 @@ def test_probe_builds_and_doubles(probe_mod, name):
 
 
 @pytest.mark.parametrize("name", ["manual2_stencil_k4",
-                                  "manual4_stencil_k4"])
+                                  "manual4_stencil_k4",
+                                  "manual4s_stencil_k4"])
 def test_stencil_probe_pair_equivalent(probe_mod, name):
     """The manual-pipeline stencil probes must compute EXACTLY what the
     auto-pipeline control computes — otherwise the measured pair would
