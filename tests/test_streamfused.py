@@ -124,6 +124,18 @@ def test_xwindowed_wave_two_fields():
     _equiv("wave3d", (24, 32, 768), 4, tiles=(8, 16, 256))
 
 
+@pytest.mark.slow
+def test_xwindowed_degenerate_window_covers_whole_x():
+    # wx == X exactly: every x program clamps to xlo=0 and re-reads the
+    # whole row — redundant but must stay correct
+    _equiv("heat3d", (24, 32, 512), 4, tiles=(8, 16, 256))
+
+
+@pytest.mark.slow
+def test_xwindowed_wider_lane_extent():
+    _equiv("heat3d", (24, 32, 1024), 4, tiles=(8, 16, 512))
+
+
 def test_xwindowed_rejects_bad_bx():
     st = make_stencil("heat3d")
     # bx not a lane-tile multiple / no room for the shells
