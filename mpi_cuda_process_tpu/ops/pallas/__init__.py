@@ -1,3 +1,6 @@
 from .kernels import has_pallas_kernel, make_pallas_compute
+from .fused import make_fused_step
+from .streamfused import make_stream_fused_step
 
-__all__ = ["has_pallas_kernel", "make_pallas_compute"]
+__all__ = ["has_pallas_kernel", "make_pallas_compute", "make_fused_step",
+           "make_stream_fused_step"]
