@@ -1,7 +1,7 @@
 """Single-chip measurement campaign for the BASELINE.md perf table.
 
 Runs the full config matrix on the real TPU and appends each result to
-``benchmarks/results_r03.json`` IMMEDIATELY after it is measured, so a
+``benchmarks/results_r05.json`` IMMEDIATELY after it is measured, so a
 wedged tunnel mid-campaign loses only the in-flight config.  Errored
 configs are retried on the next invocation (only successful records are
 skip-cached), so a transient tunnel failure heals on re-run.
@@ -332,6 +332,15 @@ CONFIGS = [
      "stream4"),
     ("heat3d27_512_f32_stream4", "heat3d27", (512, 512, 512), 8, "float32",
      "stream4"),
+    # halo-2 deeper blocking (VERDICT r4 #6): the only 3D family where
+    # temporal blocking has lost so far.  fused4 (margin 8) is a NEW
+    # halo-2 k=4 compile at 512^3 — Tier D, not B, so a hang gets the
+    # long budget and cannot cost the safe tiers; stream4's margins are
+    # sublane-rounded, so wm=8 hosts it
+    ("heat3d4th_512_f32_fused4", "heat3d4th", (512, 512, 512), 6, "float32",
+     "fused4"),
+    ("heat3d4th_512_f32_stream4", "heat3d4th", (512, 512, 512), 6,
+     "float32", "stream4"),
     # D3: the bf16 story (VERDICT #3) at the proven-compile size
     ("heat3d_256_bf16_padfree8", "heat3d", (256, 256, 256), 13, "bfloat16",
      "padfree8"),
@@ -377,6 +386,59 @@ _RISKY = frozenset(
 # builder are retried instead of skipped — tileability is a property of the
 # CODE, not the config (round-3 advisor finding).
 BUILDER_REV = 4
+
+
+def _skip_cached(cached):
+    """True iff a cached record needs no re-run — THE skip rule.
+
+    Skips successes AND deterministic-at-this-builder-rev failures:
+     - "untileable" structural declines (pure-Python ValueError,
+       identical on every run);
+     - recorded subprocess TIMEOUTS (presumed Mosaic compile hangs):
+       retrying one re-kills a live remote compile, which is exactly
+       what wedges the tunnel (2026-07-31) — retry only via --only or a
+       BUILDER_REV bump after a builder change.
+    Transient failures (tunnel/RPC/OOM) are retried.  A suspect timeout
+    (post-kill probe failed, so the hang may not have been this label's
+    fault) is treated as transient; the start-of-run probe guarantees
+    the retry only ever happens against a healthy tunnel.
+
+    Single definition shared by main(), --count-runnable, and the
+    recovery watcher (watch_tunnel.sh) — a round-4 advisor finding: the
+    watcher used to re-derive this rule by regex-scraping this file.
+    """
+    return cached is not None and (
+        "error" not in cached
+        or (("untileable" in cached.get("error", "")
+             or (cached.get("timeout") and not cached.get("suspect")))
+            and cached.get("builder_rev") == BUILDER_REV))
+
+
+def count_runnable(out_path):
+    """How many campaign labels a plain run would still execute."""
+    results = _read_results(out_path)
+    return sum(1 for label, *_ in CONFIGS
+               if not _skip_cached(results.get(label)))
+
+
+def _seed_results(out_path, default_out):
+    """Seed this round's table from the previous round's (default out
+    path ONLY — a user-chosen --out means a deliberately fresh
+    campaign): successful measurements carry over (their measured_at
+    stamps keep provenance); errored labels retry via the skip rule."""
+    if out_path != default_out or os.path.exists(out_path):
+        return
+    prev = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "results_r04.json")
+    if os.path.exists(prev):
+        # atomic (tmp + rename), like _write_results: a copy killed
+        # mid-write must not leave a truncated table that os.path.exists
+        # would treat as already-seeded on the next run
+        import shutil
+
+        tmp = out_path + ".tmp"
+        shutil.copy(prev, tmp)
+        os.replace(tmp, out_path)
 
 
 def _read_results(out_path):
@@ -446,12 +508,21 @@ def _tunnel_probe_ok(timeout_s=180):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "results_r04.json"))
+        os.path.dirname(os.path.abspath(__file__)), "results_r05.json"))
     ap.add_argument("--only", nargs="*", default=None)
     ap.add_argument("--in-process", action="store_true",
                     help="measure in this process instead of one subprocess "
                          "per config (an OOM then poisons later configs)")
+    ap.add_argument("--count-runnable", action="store_true",
+                    help="print how many labels a plain run would still "
+                         "execute, then exit (no backend contact — safe on "
+                         "a wedged tunnel; used by watch_tunnel.sh)")
     args = ap.parse_args()
+
+    if args.count_runnable:
+        _seed_results(args.out, ap.get_default("out"))
+        print(count_runnable(args.out))
+        return
 
     known = {label for label, *_ in CONFIGS}
     unknown = set(args.only or ()) - known
@@ -459,18 +530,7 @@ def main():
         ap.error(f"unknown --only labels {sorted(unknown)}; "
                  f"choose from {sorted(known)}")
 
-    default_out = ap.get_default("out")
-    if args.out == default_out and not os.path.exists(args.out):
-        # Seed the round-4 table from round 3 (default out path ONLY — a
-        # user-chosen --out means a deliberately fresh campaign): successful
-        # measurements carry over (their measured_at stamps keep
-        # provenance); errored labels retry below.
-        prev = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "results_r03.json")
-        if os.path.exists(prev):
-            import shutil
-
-            shutil.copy(prev, args.out)
+    _seed_results(args.out, ap.get_default("out"))
 
     results = _read_results(args.out)
 
@@ -488,25 +548,10 @@ def main():
     for label, name, grid, steps, dtype, compute in CONFIGS:
         if args.only and label not in args.only:
             continue
-        cached = results.get(label)
-        # Skip successes AND deterministic-at-this-builder-rev failures:
-        #  - "untileable" structural declines (pure-Python ValueError,
-        #    identical on every run);
-        #  - recorded subprocess TIMEOUTS (presumed Mosaic compile hangs):
-        #    retrying one re-kills a live remote compile, which is exactly
-        #    what wedges the tunnel (2026-07-31) — retry only via --only
-        #    or a BUILDER_REV bump after a builder change.
-        # Transient failures (tunnel/RPC/OOM) are retried.
-        # (a suspect timeout — post-kill probe failed, so the hang may not
-        # have been this label's fault — is treated as transient and
-        # retried; the start-of-run probe guarantees the retry only ever
-        # happens against a healthy tunnel)
-        if cached and not args.only and (
-                "error" not in cached
-                or (("untileable" in cached.get("error", "")
-                     or (cached.get("timeout")
-                         and not cached.get("suspect")))
-                    and cached.get("builder_rev") == BUILDER_REV)):
+        # _skip_cached holds the skip rule (and its rationale); --only
+        # bypasses it — that is the documented retry path for recorded
+        # timeouts and declines.
+        if not args.only and _skip_cached(results.get(label)):
             print(f"[measure] {label}: cached, skip", file=sys.stderr)
             continue
         if args.in_process:

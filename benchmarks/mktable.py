@@ -1,6 +1,6 @@
 """Regenerate BASELINE.md's measured table from the campaign record.
 
-Reads ``benchmarks/results_r04.json`` (or ``--in FILE``) and prints the
+Reads ``benchmarks/results_r05.json`` (or ``--in FILE``) and prints the
 markdown table body: one row per successful label, grouped by stencil
 family then grid size, with the ``--compute auto`` policy pick bolded via
 the live cli policy tables — so the measured table and the shipping policy
@@ -47,7 +47,7 @@ def _auto_pick(stencil: str, grid, dtype: str | None) -> str | None:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--in", dest="inp", default=os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "results_r04.json"))
+        os.path.dirname(os.path.abspath(__file__)), "results_r05.json"))
     args = ap.parse_args()
     with open(args.inp) as fh:
         results = json.load(fh)

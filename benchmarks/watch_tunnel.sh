@@ -18,8 +18,12 @@ set -u
 cd "$(dirname "$0")/.." || exit 1
 LOG="${1:-/tmp/watch_tunnel.log}"
 echo "[watch] start $(date -u +%H:%M:%S)" >> "$LOG"
+probe_ok() {
+  timeout 120 python -c "import jax, jax.numpy as jnp; print(float(jnp.ones((8,8)).sum()))" >/dev/null 2>&1
+}
+prev_left=-1
 while :; do
-  if timeout 120 python -c "import jax, jax.numpy as jnp; print(float(jnp.ones((8,8)).sum()))" >/dev/null 2>&1; then
+  if probe_ok; then
     # bench FIRST: ~5 min on proven-compile-class kernels, so the round
     # has a fresh local headline even if the campaign later re-wedges
     # the tunnel on a new compile (2026-07-31: recovery lasted ~25 min
@@ -30,30 +34,37 @@ while :; do
     fi
     echo "[watch] probe OK $(date -u +%H:%M:%S) — draining campaign" >> "$LOG"
     python benchmarks/measure.py >> "${LOG%.log}.measure.log" 2>&1
-    left=$(python - <<'EOF'
-import json, re
-src = open('benchmarks/measure.py').read()
-labels = re.findall(r'^\s*\("([a-z0-9_@]+)",', src, re.M)
-rev = int(re.search(r'^BUILDER_REV = (\d+)', src, re.M).group(1))
-try:
-    r = json.load(open('benchmarks/results_r04.json'))
-except Exception:
-    r = {}
-n = 0
-for l in labels:
-    c = r.get(l)
-    # mirror measure.main's skip rule exactly
-    if c is None or ('error' in c and not (
-            ('untileable' in c.get('error', '')
-             or (c.get('timeout') and not c.get('suspect')))
-            and c.get('builder_rev') == rev)):
-        n += 1
-print(n)
-EOF
-)
+    # single definition of the skip rule lives in measure.py (advisor r4):
+    # --count-runnable never contacts the backend, so it is wedge-safe.
+    # stderr goes to the measure log and a non-numeric/empty count is
+    # surfaced, not silently looped on (a corrupt results table would
+    # otherwise spin the watcher forever with a blank count)
+    left=$(python benchmarks/measure.py --count-runnable \
+           2>> "${LOG%.log}.measure.log")
+    case "$left" in
+      ''|*[!0-9]*)
+        echo "[watch] count-runnable failed (got '$left') — see" \
+             "${LOG%.log}.measure.log" >> "$LOG"
+        sleep 720
+        continue;;
+    esac
     echo "[watch] campaign pass done, $left runnable labels left" >> "$LOG"
-    if [ "$left" = "0" ]; then
-      echo "[watch] campaign drained — running bench.py" >> "$LOG"
+    # Drained = zero runnable labels OR no forward progress across two
+    # consecutive passes.  Some labels error deterministically but are
+    # deliberately retried by the skip rule (expected OOMs, Mosaic
+    # INTERNAL — transient-shaped), so the count may never reach 0; a
+    # pass that changes nothing means every remaining label is one of
+    # those, and re-running them forever would starve bench + smoke.
+    # The re-probe guards the other no-progress cause: a pass that
+    # aborted at its front gate because the tunnel re-wedged mid-loop.
+    if [ "$left" = "0" ] || [ "$left" = "$prev_left" ]; then
+      if ! probe_ok; then
+        echo "[watch] no progress but tunnel re-wedged — waiting" >> "$LOG"
+        sleep 720
+        continue
+      fi
+      echo "[watch] campaign drained ($left permanently-erroring labels" \
+           "left) — running bench.py" >> "$LOG"
       timeout 1200 python bench.py >> "${LOG%.log}.bench.log" 2>&1
       # runbook step 5 LAST: the smoke tier includes the newest compile
       # classes, and by now every campaign number is already recorded
@@ -63,6 +74,7 @@ EOF
       echo "[watch] smoke rc=$?; exiting $(date -u +%H:%M:%S)" >> "$LOG"
       exit 0
     fi
+    prev_left=$left
   else
     echo "[watch] probe failed $(date -u +%H:%M:%S)" >> "$LOG"
   fi

@@ -79,6 +79,12 @@ _NSLOTS = 4
 # definition is shared.
 _XSHELL = _XWIN_GX
 
+# z-chunk candidates for the strip picker, largest first.  If this ladder
+# ever grows past 2*_XSHELL, the picker's wm <= _XSHELL filter on
+# x-window candidates becomes load-bearing (see _pick_strip) — the
+# constant exists so tests can exercise that interaction.
+_BZ_LADDER = (32, 16, 8)
+
 
 def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
                  gshape, parity, origin_z, ins, outs, slabs):
@@ -271,11 +277,19 @@ def _pick_strip(Z, Y, X, wm, wm_a, itemsize, nfields, sharded=False):
     least total read amplification, then largest z chunk (fewer ring
     warm-ups and sem ops per pass)."""
     budget_item = max(itemsize, 4)  # bf16 budgeted at the f32 envelope
-    x_options = [None] + [
+    # x-windowed strips clamp their 128-lane shells at the global x walls,
+    # which is only sound while the window margin fits inside one shell
+    # (wm <= _XSHELL) — the same gate _stream_gates enforces on explicit
+    # tiles.  Today the bz ladder (max 32) already excludes wm > 128 via
+    # the 2*wm <= bz gate, so this filter is belt-and-braces: it keeps
+    # candidate generation aligned with _stream_gates if the bz ladder
+    # ever grows past 2*_XSHELL (otherwise the picker could choose an
+    # x-window the gate rejects outright instead of a whole-lane strip).
+    x_options = [None] + ([
         c for c in (2048, 1024, 512, 256)
-        if X % c == 0 and c + 2 * _XSHELL <= X]
+        if X % c == 0 and c + 2 * _XSHELL <= X] if wm <= _XSHELL else [])
     best = None
-    for bz in (32, 16, 8):
+    for bz in _BZ_LADDER:
         if Z % bz or 2 * wm > bz or Z // bz < 3:
             continue
         for by in (128, 64, 32, 16, 8):

@@ -149,14 +149,29 @@ def estimate_run_bytes(
             # HBM holds only state + output.  Probe construction (pure
             # Python) so a "fits" never describes an unconstructible run;
             # when unbuildable, cli.build refuses before any allocation.
+            # The unsharded kernel is guard-frame, unbatched only
+            # (cli.build rejects --periodic/--ensemble before building),
+            # so those configs are UNBUILDABLE here too — the estimate
+            # must describe the path the run actually takes.
             from ..ops.pallas.streamfused import make_stream_fused_step
 
-            ok = make_stream_fused_step(stencil, grid, fuse,
-                                        interpret=True) is not None
-            parts.append(
-                ("streaming fused: no pad transient" if ok else
-                 "streaming fused: UNBUILDABLE for this shape (the run "
-                 "refuses before allocating)", 0))
+            # `not ensemble`, not `batch == 1`: cli rejects ANY truthy
+            # --ensemble (including 1), and batch folds 0 and 1 together
+            ok = (not periodic and not ensemble
+                  and make_stream_fused_step(stencil, grid, fuse,
+                                             interpret=True) is not None)
+            if ok:
+                label = "streaming fused: no pad transient"
+            elif periodic or ensemble:
+                # name the flags, not the shape: the fix is dropping
+                # --periodic/--ensemble, not resizing the grid
+                label = ("streaming fused: UNBUILDABLE — stream is "
+                         "guard-frame, unbatched only (the run refuses "
+                         "before allocating)")
+            else:
+                label = ("streaming fused: UNBUILDABLE for this shape "
+                         "(the run refuses before allocating)")
+            parts.append((label, 0))
         elif fuse_kind == "padfree":
             # forced pad-free: there is no padded fallback (cli.build
             # raises instead), so never estimate the padded transient

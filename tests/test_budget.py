@@ -122,6 +122,20 @@ def test_stream_kind_has_no_transient_and_probes_buildability():
     _, parts2 = budget.estimate_run_bytes(
         st, (16, 16, 128), fuse=4, fuse_kind="stream")
     assert any("UNBUILDABLE" in label for label, _ in parts2)
+    # periodic / ensemble: cli.build rejects stream for both (guard-frame,
+    # unbatched only), so the estimate must label the path UNBUILDABLE
+    # rather than describe a kernel the run never takes (round-4 advisor)
+    _, parts3 = budget.estimate_run_bytes(
+        st, (256,) * 3, fuse=4, fuse_kind="stream", periodic=True)
+    assert any("UNBUILDABLE" in label for label, _ in parts3)
+    _, parts4 = budget.estimate_run_bytes(
+        st, (256,) * 3, fuse=4, fuse_kind="stream", ensemble=2)
+    assert any("UNBUILDABLE" in label for label, _ in parts4)
+    # --ensemble 1 is still an ensemble run to cli.build (any truthy
+    # value raises); batch folds 0 and 1 together, so gate on ensemble
+    _, parts5 = budget.estimate_run_bytes(
+        st, (256,) * 3, fuse=4, fuse_kind="stream", ensemble=1)
+    assert any("UNBUILDABLE" in label for label, _ in parts5)
 
 
 def test_config5_stream_envelope_builder_verified():

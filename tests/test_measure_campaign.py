@@ -118,6 +118,44 @@ def test_untileable_decline_skipped_at_current_rev(M, tmp_path):
         "heat2d_512_f32"] == rec
 
 
+def test_count_runnable_matches_skip_rule(M, tmp_path):
+    """--count-runnable and main() must share one skip-rule definition
+    (round-4 advisor: the recovery watcher used to re-derive the rule by
+    regex-scraping measure.py and could loop forever on drift)."""
+    labels = [label for label, *_ in M.CONFIGS]
+    out = str(tmp_path / "r.json")
+    rev = M.BUILDER_REV
+    (tmp_path / "r.json").write_text(json.dumps({
+        labels[0]: {"mcells_per_s": 1.0},                      # success
+        labels[1]: {"error": "untileable fused k=4",
+                    "builder_rev": rev},                       # decline
+        labels[2]: {"error": "subprocess timeout (2400s)",
+                    "timeout": True, "builder_rev": rev},      # hang
+        labels[3]: {"error": "subprocess timeout (2400s)", "timeout": True,
+                    "suspect": True, "builder_rev": rev},      # ambiguous
+        labels[4]: {"error": "RESOURCE_EXHAUSTED"},            # transient
+    }))
+    # skipped: success, current-rev decline, current-rev timeout;
+    # runnable: suspect timeout, transient error, every unrecorded label
+    assert M.count_runnable(out) == len(labels) - 3
+    assert not M._skip_cached(None)
+    assert not M._skip_cached({"error": "untileable",
+                               "builder_rev": rev - 1})
+
+
+def test_count_runnable_cli_prints_count(M, tmp_path, capsys):
+    out = str(tmp_path / "r.json")
+    (tmp_path / "r.json").write_text(json.dumps(
+        {label: {"mcells_per_s": 1.0} for label, *_ in M.CONFIGS}))
+    argv = sys.argv
+    sys.argv = ["measure.py", "--out", out, "--count-runnable"]
+    try:
+        M.main()
+    finally:
+        sys.argv = argv
+    assert capsys.readouterr().out.strip() == "0"
+
+
 def test_merge_record_preserves_other_labels(M, tmp_path):
     out = str(tmp_path / "r.json")
     (tmp_path / "r.json").write_text(json.dumps({"other": {"x": 1}}))

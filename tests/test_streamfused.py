@@ -145,6 +145,33 @@ def test_xwindowed_rejects_bad_bx():
                                   interpret=True) is None
 
 
+def test_pick_strip_never_offers_xwindow_past_shell_margin(monkeypatch):
+    """_pick_strip must never return an x-windowed strip when the window
+    margin exceeds the 128-lane shell (wm > _XSHELL), because
+    _stream_gates rejects that class outright instead of retrying other
+    geometries (round-4 advisor).  TODAY the bz ladder (max 32) makes
+    every wm > 128 candidate fail the 2*wm <= bz gate before x_options
+    matters, so the filter is exercised by growing the ladder past
+    2*_XSHELL — the exact future change that would make it live."""
+    from mpi_cuda_process_tpu.ops.pallas import streamfused as sf
+
+    wm = sf._XSHELL + 8  # margin one step past the shell
+    wm_a = wm            # already sublane-aligned for f32
+    # current ladder: no z-chunk can host 2*wm planes — no strip at all,
+    # so the explicit-tiles path in _stream_gates is the only live check
+    assert sf._pick_strip(4096, 4096, 32768, wm, wm_a, 4, 1) is None
+    # the one configuration where the filter is load-bearing: a grown
+    # ladder hosts the margin, whole-lane strips exceed the VMEM budget
+    # (X very wide), and an x-window would FIT — verified: (512, 64, 256)
+    # lives at ~4.98 GB vs whole-lane ~318 GB.  The picker must decline
+    # rather than offer the x-window _stream_gates rejects outright.
+    monkeypatch.setattr(sf, "_BZ_LADDER", (512,))
+    monkeypatch.setattr(sf, "_VMEM_LIMIT", 5 * 10**9)
+    assert sf._strip_live_bytes(512, 64, 256, 32768, wm, wm_a, 4, 1,
+                                False) < 5 * 10**9  # x-window would fit
+    assert sf._pick_strip(4096, 4096, 32768, wm, wm_a, 4, 1) is None
+
+
 def test_config5_wave_constructs_via_x_windowing():
     """The config-5 gap closed: two-field wave3d at the 64-chip local
     shape (64, 4096, 4096) exceeds the whole-lane VMEM gate but tiles
