@@ -205,6 +205,31 @@ def test_f32_at_4096_fits_on_z_only_mesh_padfree():
     assert any("pad-free" in label for label, _ in parts)
 
 
+def test_config5_stream_budget_exact_bytes():
+    """The launch-day arithmetic, pinned to the byte: config 5 (wave3d
+    4096^3, 64x1x1 z-mesh, --fuse 4 --fuse-kind stream) per-device live
+    bytes.  bf16: 2 fields x 2 GiB state + 2 GiB donated out + 0.5 GiB
+    slab operands, +10% workspace = 7,677,254,041 B (7.150 GiB);
+    f32 doubles it to 14.300 GiB.  Both fit 16 GiB v5e HBM — config 5
+    is budget-clean in BOTH dtypes on the streaming path, and the
+    breakdown the operator reads at launch is exactly this."""
+    item = {"bfloat16": 2, "float32": 4}
+    for dtype, total_expect in (("bfloat16", 7_677_254_041),
+                                ("float32", 15_354_508_083)):
+        st = make_stencil("wave3d", dtype=dtype)
+        total, parts = budget.estimate_run_bytes(
+            st, (4096,) * 3, mesh=(64, 1, 1), fuse=4, fuse_kind="stream")
+        # independent arithmetic (not the module's own constants)
+        lz, ly, lx = 64, 4096, 4096
+        state = 2 * lz * ly * lx * item[dtype]
+        out = lz * ly * lx * item[dtype]
+        slabs = 2 * 4 * ly * lx * item[dtype] * 2  # 2 sides x m=4, 2 fields
+        assert total == int((state + out + slabs) * 1.10) == total_expect
+        assert any("slab operands only" in label for label, _ in parts)
+        budget.check_budget(st, (4096,) * 3, mesh=(64, 1, 1), fuse=4,
+                            fuse_kind="stream", hbm_bytes=16 * GiB)
+
+
 def test_config5_wave_f32_fits_via_wide_x_kernel():
     """Two-field wave3d cannot tile the WHOLE-ROW z-slab window at X=4096
     (VMEM gate), but the wide-X variant windows the lane axis and tiles —

@@ -304,6 +304,28 @@ def test_fuse_kind_stream_with_mesh_matches_plain_run():
         np.asarray(stream[0]), np.asarray(plain[0]), rtol=0, atol=1e-4)
 
 
+def test_config5_rehearsal_reduced_scale():
+    """BASELINE config 5's exact command SHAPE at 1/64 scale: two-field
+    wave3d, bf16, z-only 8-way mesh, --fuse 4 --fuse-kind stream,
+    --mem-check on — the v5e-64 launch in docs/EXECUTION.md is this
+    command with the grid swapped to 4096^3 and the mesh to 64,1,1.
+    Pins (a) the command executes end-to-end through the sharded
+    streaming kernel on the dryrun-class mesh and (b) equals the plain
+    unsharded run (so the rehearsal is a correctness statement, not just
+    a smoke)."""
+    args = ["--stencil", "wave3d", "--grid", "192,64,128", "--iters", "8",
+            "--mesh", "8,1,1", "--fuse", "4", "--fuse-kind", "stream",
+            "--dtype", "bfloat16", "--mem-check", "error"]
+    fields, mcells = run(config_from_args(args))
+    assert mcells > 0
+    plain, _ = run(config_from_args(
+        ["--stencil", "wave3d", "--grid", "192,64,128", "--iters", "8",
+         "--dtype", "bfloat16"]))
+    np.testing.assert_allclose(
+        np.asarray(fields[0], np.float32), np.asarray(plain[0], np.float32),
+        rtol=0, atol=1e-3)
+
+
 def test_fuse_kind_rejects_bad_configs():
     import pytest
 
