@@ -281,7 +281,11 @@ def _check_width_k(halo, mesh_i, local, seed):
         np.asarray(got[0]), np.asarray(ref[0]), rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.parametrize("halo", [1, 2, 3])
+# halo 1 and 2 are the shipped families' widths (heat3d4th is halo 2);
+# halo 3 is synthetic future-proofing — slow tier (round-5 CI trim), and
+# the wide property below draws halo 1-3 freely anyway.
+@pytest.mark.parametrize(
+    "halo", [1, 2, pytest.param(3, marks=pytest.mark.slow)])
 def test_sharded_width_k_halo(halo):
     """Halo widths 1-3 cross shard boundaries correctly (synthetic op)."""
     _check_width_k(halo, mesh_i=2, local=(4, 5), seed=11)
