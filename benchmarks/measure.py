@@ -301,67 +301,78 @@ CONFIGS = [
      "full16"),
     # ── Tier D: NEW large Mosaic compiles — value-ordered, _RISKY budget,
     # timeouts recorded.  A hang near the top must not cost the numbers
-    # below it on a rerun (recorded timeouts are skipped). ──
-    # D1: THE gateway number (VERDICT missing #2) — 1024^3 f32 via the
-    # pad-free kernel; explicit (16,16) tiles first (smallest window =
-    # smallest Mosaic program; the auto pick (32,32) follows)
-    ("heat3d_1024_f32_padfree4_t16", "heat3d", (1024, 1024, 1024), 4,
-     "float32", "padfree4@16x16"),
-    ("heat3d_1024_f32_padfree4", "heat3d", (1024, 1024, 1024), 4, "float32",
-     "padfree4"),
-    # D2: the deep-k ceiling probe (VERDICT #5) — padded class, proven at
-    # 512^3 k=4; k=8 doubles per-pass amortization via the fori_loop body
-    ("heat3d_512_f32_fused8", "heat3d", (512, 512, 512), 6, "float32",
-     "fused8"),
-    # D2.5: the STREAMING kernel (ops/pallas/streamfused.py) — sliding-
-    # window manual DMA, zero z read amplification: projects ~155 Gcells/s
-    # at 512^3 even at the 330 GB/s auto rate.  New compile class
-    # (run_scoped + make_async_copy + ANY refs at scale): cheapest grid
-    # first to prove the class compiles
+    # below it on a RERUN (recorded timeouts are skipped) — but a hang's
+    # kill can wedge the tunnel and cost everything below it on THIS
+    # pass, so the order is (a) VERDICT-r4 value rank (streams > 1024^3
+    # > bf16 > padfree generality > halo-2 > deep k) and (b) the suspect
+    # compile class (AUTO-tiled padfree at >=512^3, whose kill wedged
+    # the tunnel on 2026-07-31) last within its group. ──
+    # D1: the STREAMING kernel (ops/pallas/streamfused.py) — sliding-
+    # window manual DMA, zero z read amplification: projects ~155
+    # Gcells/s at 512^3 even at the 330 GB/s auto rate; decides
+    # _AUTO_FUSE_KIND ("the headline question", VERDICT r4 next #2).
+    # New compile class (run_scoped + make_async_copy + ANY refs at
+    # scale): cheapest grid first to prove the class compiles.
     ("heat3d_256_f32_stream4", "heat3d", (256, 256, 256), 25, "float32",
      "stream4"),
     ("heat3d_512_f32_stream4", "heat3d", (512, 512, 512), 10, "float32",
      "stream4"),
+    # the only bf16 k=4 temporal-blocking path (VERDICT r4 next #4)
     ("heat3d_512_bf16_stream4", "heat3d", (512, 512, 512), 10, "bfloat16",
      "stream4"),
-    ("heat3d_512_f32_stream8", "heat3d", (512, 512, 512), 6, "float32",
-     "stream8"),
-    ("heat3d_1024_f32_stream4", "heat3d", (1024, 1024, 1024), 4, "float32",
-     "stream4"),
+    # config-5's family: two-field wave through the same class
     ("wave3d_512_f32_stream4", "wave3d", (512, 512, 512), 8, "float32",
      "stream4"),
-    ("heat3d27_512_f32_stream4", "heat3d27", (512, 512, 512), 8, "float32",
+    # D2: the >=1024^3 regime (VERDICT r4 next #3) — explicit (16,16)
+    # tiles first (smallest window = smallest Mosaic program), then
+    # stream; the AUTO-tiled padfree label LAST (the suspect class)
+    ("heat3d_1024_f32_padfree4_t16", "heat3d", (1024, 1024, 1024), 4,
+     "float32", "padfree4@16x16"),
+    ("heat3d_1024_f32_stream4", "heat3d", (1024, 1024, 1024), 4, "float32",
      "stream4"),
-    # halo-2 deeper blocking (VERDICT r4 #6): the only 3D family where
-    # temporal blocking has lost so far.  fused4 (margin 8) is a NEW
-    # halo-2 k=4 compile at 512^3 — Tier D, not B, so a hang gets the
-    # long budget and cannot cost the safe tiers; stream4's margins are
-    # sublane-rounded, so wm=8 hosts it
-    ("heat3d4th_512_f32_fused4", "heat3d4th", (512, 512, 512), 6, "float32",
-     "fused4"),
-    ("heat3d4th_512_f32_stream4", "heat3d4th", (512, 512, 512), 6,
-     "float32", "stream4"),
-    # D3: the bf16 story (VERDICT #3) at the proven-compile size
+    ("heat3d_1024_f32_padfree4", "heat3d", (1024, 1024, 1024), 4, "float32",
+     "padfree4"),
+    # D3: the bf16 story (VERDICT r4 next #4) at the proven-compile size
+    # first; the fori_loop k=8 lowering is the designed fix for the
+    # round-3 unrolled-compile hang
     ("heat3d_256_bf16_padfree8", "heat3d", (256, 256, 256), 13, "bfloat16",
      "padfree8"),
     ("heat3d_256_bf16_fused8", "heat3d", (256, 256, 256), 13, "bfloat16",
      "fused8"),
+    ("heat3d_512_bf16_padfree8", "heat3d", (512, 512, 512), 6, "bfloat16",
+     "padfree8"),
     ("heat3d_1024_bf16_padfree8", "heat3d", (1024, 1024, 1024), 4,
      "bfloat16", "padfree8"),
-    # D4: padfree generality at 512^3 (wave/27-point) + the explicit-tile
-    # hedge for the label whose auto-tiled compile hung on 2026-07-31
+    # D4: padfree generality at 512^3.  The heat3d t16 hedge FIRST (it
+    # discriminates the hang hypotheses in docs/STATE.md); wave/27-point
+    # auto-tiled labels after it; the heat3d AUTO label last — it is the
+    # exact label whose kill wedged the tunnel (skip-cached at rev
+    # parity; runs again only after a BUILDER_REV bump or --only)
+    ("heat3d_512_f32_padfree4_t16", "heat3d", (512, 512, 512), 10,
+     "float32", "padfree4@16x16"),
     ("wave3d_512_f32_padfree4", "wave3d", (512, 512, 512), 8, "float32",
      "padfree4"),
     ("heat3d27_512_f32_padfree4", "heat3d27", (512, 512, 512), 8, "float32",
      "padfree4"),
-    ("heat3d_512_f32_padfree4_t16", "heat3d", (512, 512, 512), 10,
-     "float32", "padfree4@16x16"),
     ("heat3d_512_f32_padfree4", "heat3d", (512, 512, 512), 10, "float32",
      "padfree4"),
-    # D5: deeper ceiling probes
+    # D5: the halo-2 family (VERDICT r4 next #6): fused4 (margin 8) is a
+    # NEW halo-2 k=4 compile at 512^3 — Tier D so a hang gets the long
+    # budget and cannot cost the safe tiers; stream4's sublane-rounded
+    # margins host wm=8
+    ("heat3d4th_512_f32_fused4", "heat3d4th", (512, 512, 512), 6, "float32",
+     "fused4"),
+    ("heat3d4th_512_f32_stream4", "heat3d4th", (512, 512, 512), 6,
+     "float32", "stream4"),
+    # D6: deeper ceiling probes (k=8/16 per-pass amortization, stream8,
+    # 27-point stream)
+    ("heat3d_512_f32_fused8", "heat3d", (512, 512, 512), 6, "float32",
+     "fused8"),
+    ("heat3d_512_f32_stream8", "heat3d", (512, 512, 512), 6, "float32",
+     "stream8"),
+    ("heat3d27_512_f32_stream4", "heat3d27", (512, 512, 512), 8, "float32",
+     "stream4"),
     ("heat3d_512_f32_padfree8", "heat3d", (512, 512, 512), 6, "float32",
-     "padfree8"),
-    ("heat3d_512_bf16_padfree8", "heat3d", (512, 512, 512), 6, "bfloat16",
      "padfree8"),
     ("heat3d_512_f32_fused16", "heat3d", (512, 512, 512), 3, "float32",
      "fused16"),
@@ -374,7 +385,7 @@ CONFIGS = [
 # at/after the first Tier-D row is risky, so a new Tier-D label can't
 # silently get the short budget.
 _RISKY_BUDGET_S = 2400
-_TIER_D_START = "heat3d_1024_f32_padfree4_t16"
+_TIER_D_START = "heat3d_256_f32_stream4"
 _RISKY = frozenset(
     label for label, *_ in
     CONFIGS[[label for label, *_ in CONFIGS].index(_TIER_D_START):])
