@@ -124,17 +124,47 @@ def _watchdog():
 if __name__ == "__main__":
     threading.Thread(target=_watchdog, daemon=True).start()
 
-import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
 
-# In-process CPU forcing for smoke tests / wedged-tunnel runs (the env var
-# JAX_PLATFORMS alone is overridden by the axon sitecustomize); the recipe
-# lives in repo-root cpuforce.py.
+def _tpu_reachable(timeout_s: float = 120.0) -> bool:
+    """Probe backend discovery in a CHILD process with a hard timeout.
+
+    On a box with no TPU (or a wedged axon tunnel) ``import jax`` +
+    backend discovery itself can hang indefinitely — that is exactly the
+    BENCH_r05 failure: the watchdog fired and the scoreboard recorded
+    0.0.  A subprocess probe turns "discovery hangs" into "probe times
+    out", after which the parent forces the CPU backend BEFORE its own
+    first jax use and measures an honest CPU number instead.
+    """
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('BACKEND=' + jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except Exception:
+        return False
+    return out.returncode == 0 and "BACKEND=tpu" in out.stdout
+
+
+# In-process CPU forcing for smoke tests / wedged-tunnel / no-TPU runs
+# (the env var JAX_PLATFORMS alone is overridden by the axon
+# sitecustomize); the recipe lives in repo-root cpuforce.py.  Forced
+# explicitly via BENCH_FORCE_CPU, or automatically when the probe says no
+# healthy TPU backend is reachable.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+if __name__ == "__main__" and not os.environ.get("BENCH_FORCE_CPU") \
+        and not _tpu_reachable():
+    print("[bench] no reachable TPU backend (probe); measuring on CPU",
+          file=sys.stderr)
+    os.environ["BENCH_FORCE_CPU"] = "1"
 if os.environ.get("BENCH_FORCE_CPU"):
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from cpuforce import force_cpu  # noqa: E402
 
     force_cpu()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 
 
 def _fence(fields) -> float:
@@ -234,7 +264,16 @@ def main():
         "unit": "Mcells/s",
         "vs_baseline": round(mcells / BASELINE_MCELLS, 4),
         "compute": compute,
+        "backend": backend,
     }
+    if backend != "tpu":
+        # honest fallback measurement, never a zero scoreboard: a real
+        # small-grid CPU number, provenance-tagged, with the pointer at
+        # the committed real-chip campaign table
+        rec["note"] = (
+            "CPU-backend fallback measurement (no reachable TPU this "
+            "run); for real-chip numbers see the campaign table in "
+            "benchmarks/results_r0*.json")
     if suspect:
         rec["suspect"] = True
         rec["note"] = ("N-vs-4N time delta below the noise floor "

@@ -63,7 +63,10 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
     """compute: jnp | pallas (compute_fn inside the pad step) |
     raw (whole-step raw kernel) | fusedK (3D windowed temporal blocking,
     K steps/pass; ``fusedK@BZxBY`` pins explicit tiles) | fullK (2D
-    whole-grid-in-VMEM temporal blocking) | copy (harness-calibration
+    whole-grid-in-VMEM temporal blocking) | shfusedK / overlapK (sharded
+    fused step over a z-only mesh of ALL devices, K steps per width-m
+    exchange — overlapK adds the communication-overlapped interior/
+    boundary split; needs >= 2 devices) | copy (harness-calibration
     1R+1W elementwise scan).
     """
     kw = dict(params or {})
@@ -110,6 +113,43 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
         step = make_stream_fused_step(st, grid, step_unit, tiles=tiles)
         if step is None:
             raise ValueError(f"untileable stream k={step_unit} for {grid}")
+    elif compute.startswith("overlap") or compute.startswith("shfused"):
+        # sharded temporal blocking over a z-only mesh of ALL devices:
+        # shfusedK = exchange-then-compute (the A row), overlapK = the
+        # communication-overlapped interior/boundary split (the B row).
+        # The A/B pair prices exactly the ~7%-class serial exchange gap
+        # of docs/STATE.md item 6.
+        from mpi_cuda_process_tpu import make_mesh, shard_fields
+        from mpi_cuda_process_tpu.parallel.stepper import (
+            make_sharded_fused_step,
+        )
+
+        ov = compute.startswith("overlap")
+        step_unit, tiles = _parse_kspec(
+            compute[len("overlap" if ov else "shfused"):])
+        if tiles is not None:
+            raise ValueError("sharded fused labels take no tile spec")
+        n_dev = len(jax.devices())
+        if n_dev < 2:
+            # environmental, not structural: retried on every run so the
+            # first healthy multi-chip session prices these labels
+            raise ValueError(
+                f"sharded fused labels need >= 2 devices (have {n_dev})")
+        mesh = make_mesh((n_dev, 1, 1))
+        step = make_sharded_fused_step(st, mesh, grid, step_unit,
+                                       overlap=ov)
+        if step is None:
+            raise ValueError(
+                f"untileable sharded fused k={step_unit} for {grid} on "
+                f"{n_dev} devices")
+        if ov and not getattr(step, "_overlap_active", False):
+            raise ValueError(
+                f"untileable overlap split for {grid} on {n_dev} devices "
+                "(local z < 3m) — must not price the plain step under an "
+                "overlap label")
+        mk = lambda: shard_fields(  # noqa: E731
+            init_state(st, grid, kind="auto"), mesh, st.ndim)
+        return _time_scan(step, mk, grid, steps, reps, step_unit)
     elif compute.startswith("fused"):
         from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
         step_unit, tiles = _parse_kspec(compute[len("fused"):])
@@ -376,6 +416,22 @@ CONFIGS = [
      "padfree8"),
     ("heat3d_512_f32_fused16", "heat3d", (512, 512, 512), 3, "float32",
      "fused16"),
+    # D7: communication-overlapped temporal blocking A/B (needs a multi-
+    # chip slice; on a single chip these decline fast and retry next
+    # run).  shfusedK = exchange-then-compute over a z-only mesh of all
+    # devices, overlapK = the interior/boundary split — the pair prices
+    # the ~7%-class serial exchange gap (docs/STATE.md item 6) that the
+    # split is designed to hide.  Mesh = (n_devices, 1, 1).
+    ("heat3d_512_f32_shfused4", "heat3d", (512, 512, 512), 10, "float32",
+     "shfused4"),
+    ("heat3d_512_f32_overlap4", "heat3d", (512, 512, 512), 10, "float32",
+     "overlap4"),
+    ("heat3d_512_f32_overlap8", "heat3d", (512, 512, 512), 6, "float32",
+     "overlap8"),
+    ("wave3d_512_f32_shfused4", "wave3d", (512, 512, 512), 8, "float32",
+     "shfused4"),
+    ("wave3d_512_f32_overlap4", "wave3d", (512, 512, 512), 8, "float32",
+     "overlap4"),
 ]
 
 # Tier-D labels: new large Mosaic compiles.  A hang here is plausibly a
@@ -396,7 +452,7 @@ _RISKY = frozenset(
 # gate, new kernel variant).  Cached untileable declines from an older
 # builder are retried instead of skipped — tileability is a property of the
 # CODE, not the config (round-3 advisor finding).
-BUILDER_REV = 4
+BUILDER_REV = 5
 
 
 def _skip_cached(cached):

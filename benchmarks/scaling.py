@@ -92,14 +92,22 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
         if fuse > 1:
             # temporal blocking UNDER decomposition: k micro-steps per
             # width-k exchange — the 4096^3-class execution strategy
-            # (3D windowed kernel / 2D whole-local-block kernel)
+            # (3D windowed kernel / 2D whole-local-block kernel).  With
+            # ``overlap`` the width-m exchange is scheduled concurrently
+            # with the interior kernel (interior/boundary split) — the
+            # A/B rows this harness emits price exactly that split.
             from mpi_cuda_process_tpu.parallel.stepper import (
                 make_sharded_temporal_step,
             )
 
             step = make_sharded_temporal_step(st, mesh, global_shape, fuse,
-                                              kind=fuse_kind)
+                                              kind=fuse_kind,
+                                              overlap=overlap)
             if step is None:
+                return None
+            if overlap and not getattr(step, "_overlap_active", False):
+                # a row labeled overlap=true must not silently price the
+                # plain step (geometry declined the split)
                 return None
             step_unit = fuse
         else:
@@ -203,7 +211,10 @@ def main(argv=None) -> int:
     p.add_argument("--overlap", action="store_true",
                    help="use the explicit interior/boundary overlap stepper "
                         "(weak/strong modes) — compare against the default "
-                        "XLA-scheduled exchange")
+                        "XLA-scheduled exchange; composes with --fuse to "
+                        "emit the overlap A/B ladder for the temporal-"
+                        "blocked steppers (rungs that cannot host the "
+                        "split are skipped, not silently run plain)")
     p.add_argument("--fuse-kind", default=None,
                    choices=["stream"],
                    help="force the streaming (sliding-window manual-DMA) "
@@ -216,11 +227,11 @@ def main(argv=None) -> int:
                         "the lane axis whole — untileable rungs are "
                         "skipped)")
     a = p.parse_args(argv)
-    if a.fuse > 1 and a.overlap:
-        # the fused step replaces the whole exchange+update; there is no
-        # interior/boundary split to select — reject rather than emit rows
-        # whose "overlap" label misattributes fused-path numbers
-        p.error("--fuse and --overlap are mutually exclusive")
+    # --fuse + --overlap now composes: the temporal-blocked steppers carry
+    # their own interior/boundary split (stepper.make_sharded_fused_step
+    # overlap=True), so the pair emits the overlap A/B ladder for the
+    # fused kind.  Rungs whose geometry declines the split are skipped
+    # (never silently priced as plain rows).
 
     jax = _setup_devices(a.virtual)
     from mpi_cuda_process_tpu.config import parse_int_tuple

@@ -8,6 +8,18 @@ decomposition over a device mesh with per-step ``ppermute`` halo exchange, and
 communication/computation overlap — see SURVEY.md for the full blueprint.
 """
 
+import jax as _jax
+
+# Sharded init correctness depends on partitionable random bits:
+# ``init_state_sharded`` computes each device's block under jit with
+# out_shardings and must reproduce the unsharded ``init_state`` stream
+# bit-for-bit (no process ever materializes the full grid).  Newer JAX
+# defaults this flag on; older installs default it off, which silently
+# decorrelates the sharded draw from the unsharded one (seed-vs-mesh
+# mismatch in the end-to-end CLI tests).  Pin it explicitly so the
+# package's determinism contract holds on every supported JAX.
+_jax.config.update("jax_threefry_partitionable", True)
+
 from .config import RunConfig
 from .driver import make_runner, make_step, run_simulation
 from .ops import advection, heat, life, reaction, sor, wave  # noqa: F401  (register stencils)

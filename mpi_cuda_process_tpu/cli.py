@@ -78,7 +78,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler trace for the run")
     p.add_argument("--overlap", action="store_true",
                    help="explicit interior/boundary split so the halo "
-                        "exchange overlaps bulk compute (vs trusting XLA)")
+                        "exchange overlaps bulk compute (vs trusting XLA); "
+                        "composes with --fuse under --mesh (the width-m "
+                        "slab exchange then overlaps the interior fused "
+                        "kernel, boundary shells spliced after)")
     p.add_argument("--dump-every", type=int, default=0,
                    help="async-dump field0 snapshots every N steps (.npy, "
                         "non-blocking via the native writer pool)")
@@ -397,9 +400,14 @@ def build(cfg: RunConfig):
         # off-TPU) — require the explicit pairing
         raise ValueError("--fuse-kind requires an explicit --fuse K")
     if cfg.fuse:
-        if cfg.compute == "pallas" or cfg.overlap:
+        if cfg.compute == "pallas":
             raise ValueError("--fuse replaces the whole step; it excludes "
-                             "--compute pallas and --overlap")
+                             "--compute pallas")
+        if cfg.overlap and not use_mesh:
+            raise ValueError(
+                "--overlap with --fuse needs --mesh: the split overlaps "
+                "the halo exchange with the interior kernel, and an "
+                "unsharded run has no exchange to overlap")
         if cfg.fuse_kind != "auto" and (
                 st.ndim == 2
                 or (use_mesh and cfg.fuse_kind != "stream")):
@@ -416,7 +424,14 @@ def build(cfg: RunConfig):
             kind = cfg.fuse_kind if cfg.fuse_kind == "stream" else None
             fused = stepper_lib.make_sharded_temporal_step(
                 st, m, cfg.grid, cfg.fuse, periodic=cfg.periodic,
-                kind=kind)
+                kind=kind, overlap=cfg.overlap)
+            if cfg.overlap and fused is not None and \
+                    not getattr(fused, "_overlap_active", False):
+                log.warning(
+                    "--overlap: block geometry cannot host the interior/"
+                    "boundary split (local extent < 3*k*halo*phases on a "
+                    "sharded axis); running the plain exchange-then-"
+                    "compute fused step")
             if fused is None:
                 raise ValueError(
                     f"--fuse {cfg.fuse} + --mesh {cfg.mesh}"
@@ -610,7 +625,8 @@ def _check_mem_budget(cfg: RunConfig) -> None:
         total, parts = budget.check_budget(
             st, cfg.grid, mesh=cfg.mesh, fuse=cfg.fuse,
             ensemble=cfg.ensemble, periodic=cfg.periodic,
-            compute=compute, fuse_kind=cfg.fuse_kind)
+            compute=compute, fuse_kind=cfg.fuse_kind,
+            overlap=cfg.overlap)
     except ValueError:
         if cfg.mem_check == "error":
             raise

@@ -1,0 +1,58 @@
+"""Version-tolerant resolution of the Pallas-TPU symbols this package uses.
+
+JAX renamed the TPU compiler-params dataclass across releases:
+``pltpu.TPUCompilerParams`` (<= 0.4.x) became ``pltpu.CompilerParams``
+(newer releases keep the old name as a deprecated alias, until they
+don't).  The installed JAX decides which spelling exists, so hard-coding
+either one turns an environment change into six opaque test-collection
+errors (the round-5 seed failure mode).  Every pallas module resolves the
+class through :func:`compiler_params` instead.
+
+The rest of the ``pltpu`` surface this package touches (``roll``,
+``SMEM``/``ANY`` memory spaces, ``VMEM`` scratch, ``SemaphoreType``,
+``make_async_copy``) has been stable across the supported range; they are
+listed in :data:`REQUIRED_PLTPU_SYMBOLS` so the compat smoke test
+(tests/test_compat.py) fails as ONE named assertion — not as collection
+errors — the day any of them drifts too.
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+# Symbols the pallas modules reference directly off ``pltpu``; audited from
+# the package source (grep ``pltpu\.``).  The compiler-params class is
+# resolved separately below because its NAME is what drifts.
+REQUIRED_PLTPU_SYMBOLS = (
+    "roll",
+    "SMEM",
+    "VMEM",
+    "SemaphoreType",
+    "make_async_copy",
+)
+
+
+def _resolve_compiler_params_cls():
+    for name in ("CompilerParams", "TPUCompilerParams"):
+        cls = getattr(pltpu, name, None)
+        if cls is not None:
+            return cls
+    raise ImportError(
+        "jax.experimental.pallas.tpu exposes neither CompilerParams nor "
+        "TPUCompilerParams; installed JAX is outside the supported range")
+
+
+CompilerParams = _resolve_compiler_params_cls()
+
+
+def compiler_params(**kwargs):
+    """Construct the TPU compiler-params object under whichever name the
+    installed JAX exports.  Keyword-compatible across the rename
+    (``vmem_limit_bytes``, ``dimension_semantics`` are stable fields)."""
+    return CompilerParams(**kwargs)
+
+
+def missing_pltpu_symbols():
+    """Names from :data:`REQUIRED_PLTPU_SYMBOLS` absent in this JAX —
+    empty on a healthy install (asserted by tests/test_compat.py)."""
+    return [s for s in REQUIRED_PLTPU_SYMBOLS if not hasattr(pltpu, s)]

@@ -36,6 +36,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..stencil import Fields, Stencil
 
+from .compat import compiler_params
+
 from .kernels import _VMEM_LIMIT_BYTES, _interpret_default, _roll
 
 # The heat/wave/advect/grayscott/sor micro-steps read ndim from the
@@ -210,7 +212,7 @@ def _build_call(stencil, block_shape, m, k, interpret, sharded_global=None,
         out_shape=[jax.ShapeDtypeStruct((Ly, W), stencil.dtype)
                    for _ in range(nfields)],
         interpret=interpret,
-        compiler_params=None if interpret else pltpu.CompilerParams(
+        compiler_params=None if interpret else compiler_params(
             vmem_limit_bytes=_VMEM_LIMIT_BYTES),
     )
     return call, nfields

@@ -48,6 +48,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..stencil import Fields, Stencil
 
+from .compat import compiler_params
+
 from .kernels import (
     _VMEM_LIMIT_BYTES,
     _W27_CENTER,
@@ -605,7 +607,7 @@ def build_zslab_xwin_call(
         out_shape=[jax.ShapeDtypeStruct((Lz, Y, X), stencil.dtype)
                    for _ in range(nfields)],
         interpret=interpret,
-        compiler_params=None if interpret else pltpu.CompilerParams(
+        compiler_params=None if interpret else compiler_params(
             vmem_limit_bytes=_VMEM_LIMIT_BYTES,
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
     )
@@ -759,7 +761,7 @@ def build_zslab_padfree_call(
         out_shape=[jax.ShapeDtypeStruct((Lz, Y, X), stencil.dtype)
                    for _ in range(nfields)],
         interpret=interpret,
-        compiler_params=None if interpret else pltpu.CompilerParams(
+        compiler_params=None if interpret else compiler_params(
             vmem_limit_bytes=_VMEM_LIMIT_BYTES,
             dimension_semantics=("arbitrary", "arbitrary")),
     )
@@ -948,11 +950,64 @@ def build_fused_call(
         out_shape=[jax.ShapeDtypeStruct((Z, Y, X), stencil.dtype)
                    for _ in range(nfields)],
         interpret=interpret,
-        compiler_params=None if interpret else pltpu.CompilerParams(
+        compiler_params=None if interpret else compiler_params(
             vmem_limit_bytes=_VMEM_LIMIT_BYTES,
             dimension_semantics=("arbitrary", "arbitrary")),
     )
     return call, margin, nfields
+
+
+def build_overlap_shell_calls(
+    stencil: Stencil,
+    local_shape: Tuple[int, int, int],
+    global_shape: Tuple[int, int, int],
+    k: int,
+    axes: Sequence[int],
+    interpret: Optional[bool] = None,
+    periodic: bool = False,
+):
+    """Slab-shaped fused calls for the communication-overlap boundary
+    shells (``make_sharded_fused_step(overlap=True)``).
+
+    For each sharded grid axis ``d`` in ``axes`` (subset of {0, 1} — the
+    lane axis is never sharded), builds the SAME fused kernel over a
+    reduced core whose axis-``d`` extent is ``2m`` (m = k*halo*phases):
+    the width-``2m`` boundary shell at one face of the local block.  The
+    shell call consumes the exchanged neighbor slab plus a ``3m``-deep
+    local strip (padded input extent ``4m`` along ``d``), and the caller
+    offsets the SMEM origin scalars by the shell's position so the
+    in-kernel global frame mask (and red-black parity) stays exact —
+    ``build_fused_call`` already derives both from origins + program ids,
+    so no new kernel code exists here, only a reduced-extent instance.
+
+    Shells are ``2m`` deep (temporal validity needs only ``m``) because
+    the window tail BlockSpecs require block-aligned ``2m``-granularity
+    origins — ``bz = 2m`` is the smallest tileable slab — and the extra
+    ``m`` rows land on also-valid values, so the splice stays exact.
+
+    Returns ``{axis: call}`` or None when the geometry cannot host the
+    split (local extent < 3m on a sharded axis, or a shell untileable):
+    callers fall back to the non-overlapped step.
+    """
+    margin = k * _halo_per_micro(stencil)
+    shells = {}
+    for d in axes:
+        if d not in (0, 1):
+            return None
+        if int(local_shape[d]) < 3 * margin:
+            return None  # the 3m local strip would wrap into the far slab
+        core = list(int(s) for s in local_shape)
+        core[d] = 2 * margin
+        built = build_fused_call(
+            stencil, tuple(core), k, interpret=interpret,
+            sharded_global=None if periodic else tuple(global_shape),
+            periodic=periodic)
+        if built is None:
+            return None
+        call, m_shell, _ = built
+        assert m_shell == margin
+        shells[d] = call
+    return shells
 
 
 def make_fused_step(

@@ -40,6 +40,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..stencil import Fields, Stencil
+from .compat import compiler_params
 
 # Whole-2D-grid kernels hold in+out in VMEM (~16 MB); cap well below that.
 _MAX_2D_VMEM_CELLS = 2 * 1024 * 1024
@@ -51,7 +52,10 @@ _MAX_2D_VMEM_CELLS = 2 * 1024 * 1024
 # true scoped usage (pipeline double-buffers + the in-kernel concatenate +
 # tap intermediates) was 17.3 MiB against the 16 MiB default.
 _VMEM_LIMIT_BYTES = 100 * 1024 * 1024
-_COMPILER_PARAMS = pltpu.CompilerParams(
+# Constructed through the compat resolver: the class is named
+# CompilerParams or TPUCompilerParams depending on the installed JAX
+# (ops/pallas/compat.py).
+_COMPILER_PARAMS = compiler_params(
     vmem_limit_bytes=_VMEM_LIMIT_BYTES,
     dimension_semantics=("arbitrary",),
 )

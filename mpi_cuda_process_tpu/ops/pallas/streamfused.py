@@ -56,6 +56,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..stencil import Fields, Stencil
 
+from .compat import compiler_params
+
 from .kernels import _VMEM_LIMIT_BYTES, _interpret_default
 from .fused import (
     _MICRO,
@@ -423,7 +425,7 @@ def build_stream_sharded_call(
         out_shape=[jax.ShapeDtypeStruct((Lz, Y, X), stencil.dtype)
                    for _ in range(nfields)],
         interpret=interpret,
-        compiler_params=None if interpret else pltpu.CompilerParams(
+        compiler_params=None if interpret else compiler_params(
             vmem_limit_bytes=_VMEM_LIMIT_BYTES,
             dimension_semantics=("arbitrary",) * len(grid)),
     )
@@ -470,7 +472,7 @@ def make_stream_fused_step(
         out_shape=[jax.ShapeDtypeStruct((Z, Y, X), stencil.dtype)
                    for _ in range(nfields)],
         interpret=interpret,
-        compiler_params=None if interpret else pltpu.CompilerParams(
+        compiler_params=None if interpret else compiler_params(
             vmem_limit_bytes=_VMEM_LIMIT_BYTES,
             dimension_semantics=("arbitrary",) * len(grid)),
     )

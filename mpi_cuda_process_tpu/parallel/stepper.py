@@ -22,10 +22,27 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # JAX >= 0.4.35 exposes shard_map at top level
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+try:  # newer JAX exposes shard_map at top level
+    _shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
+
+# The replication/varying-mesh-axes check kwarg was renamed check_rep ->
+# check_vma across JAX releases; resolve which spelling the installed
+# version takes (the same version-tolerance discipline as
+# ops/pallas/compat.py — API drift must not break step construction).
+import inspect as _inspect
+
+_CHECK_KW = ("check_vma"
+             if "check_vma" in _inspect.signature(_shard_map).parameters
+             else "check_rep")
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` with the check kwarg normalized to ``check_vma``
+    across JAX versions.  Every stepper builds through this."""
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_CHECK_KW: check_vma})
 
 from ..driver import frame_mask
 from ..ops.stencil import Fields, Stencil
@@ -59,6 +76,28 @@ def _resolve_mesh_axes(ndim: int, mesh: Mesh):
     axis_names = tuple(n if n in mesh.shape else None for n in names_all)
     counts = tuple(mesh.shape.get(n, 1) if n else 1 for n in axis_names)
     return axis_names, counts
+
+
+def _axis_slice(x, d, sl):
+    """``x[..., sl, ...]`` with the slice on axis ``d``."""
+    idx = [slice(None)] * x.ndim
+    idx[d] = sl
+    return x[tuple(idx)]
+
+
+def _attach_overlap(step, interior_step):
+    """Wrap a shard_map'd overlap step so tests/tools can reach the
+    interior-only computation (``_interior_step``) and detect that the
+    split is active.  The interior step is the exact dependency path of
+    the overlapped step's bulk update — asserting its jaxpr contains no
+    collective-permute proves the exchange overlaps it."""
+
+    def stepper(fields: Fields) -> Fields:
+        return step(fields)
+
+    stepper._overlap_active = True
+    stepper._interior_step = interior_step
+    return stepper
 
 
 def make_sharded_step(
@@ -227,6 +266,7 @@ def make_sharded_fused_step(
     periodic: bool = False,
     padfree: Optional[bool] = None,
     kind: Optional[str] = None,
+    overlap: bool = False,
 ):
     """Temporal blocking under domain decomposition: k steps per exchange.
 
@@ -276,6 +316,27 @@ def make_sharded_fused_step(
     operands like the z-slab kernels, but every core plane is DMA'd once
     per pass — the projected config-5 winner, pending real-chip
     measurement (auto policy unchanged until then).
+
+    ``overlap=True`` selects the communication-overlapped split — the
+    temporal-blocked analogue of ``make_sharded_step(overlap=True)`` (the
+    reference's middle/border two-stream trick, kernel.cu:209-221): the
+    width-``m`` slab ``ppermute``s are issued with NO consumer feeding
+    the interior kernel, which runs on a locally-padded (padded kind) or
+    dummy-slab (pad-free/stream kinds) block and is valid everywhere
+    ``>= m`` from a sharded face; the width-``2m`` boundary shells are
+    then computed from the exchanged slabs + a ``3m``-deep local strip by
+    slab-shaped instances of the same fused kernel
+    (``fused.build_overlap_shell_calls``, origin scalars offset so the
+    in-kernel frame/parity stay exact) and spliced over the interior.
+    Values are unchanged (bit-exact int, allclose float — the micro-step
+    arithmetic is elementwise rolls, invariant to the window split);
+    only the dependency structure moves, so XLA can schedule the ICI
+    transfer concurrently with the interior kernel.  Falls back to the
+    plain exchange-then-compute step when the local geometry cannot host
+    the split (local extent < 3m on a sharded axis); the returned step
+    carries ``_overlap_active=True`` and an ``_interior_step`` attribute
+    (the interior's exact dependency path, for jaxpr inspection) when
+    the split is live.
     """
     from ..ops.pallas.fused import (
         build_fused_call,
@@ -310,13 +371,15 @@ def make_sharded_fused_step(
             return None
         return _make_zslab_padfree_step(
             stencil, mesh, global_shape, local_shape, axis_names, counts,
-            k, build_stream_sharded_call, (1, 1), interpret, periodic)
+            k, build_stream_sharded_call, (1, 1), interpret, periodic,
+            overlap=overlap)
     if padfree is None:
         padfree = z_only and prefer_padfree(stencil, local_shape)
     if padfree and z_only:
         step = _make_zslab_padfree_step(
             stencil, mesh, global_shape, local_shape, axis_names, counts,
-            k, build_zslab_padfree_call, (9, 3), interpret, periodic)
+            k, build_zslab_padfree_call, (9, 3), interpret, periodic,
+            overlap=overlap)
         if step is None:
             # whole-row windows exceed VMEM (wide X x multi-field): the
             # wide-X kernel windows the lane axis too
@@ -325,7 +388,7 @@ def make_sharded_fused_step(
             step = _make_zslab_padfree_step(
                 stencil, mesh, global_shape, local_shape, axis_names,
                 counts, k, build_zslab_xwin_call, (27, 9), interpret,
-                periodic)
+                periodic, overlap=overlap)
         if step is not None:
             return step
         # both pad-free builders declined: fall through to the padded
@@ -348,6 +411,23 @@ def make_sharded_fused_step(
     # neighbor — is already guaranteed: _pick_tiles only accepts local z/y
     # extents divisible by tiles that are multiples of 2*m)
     spec = grid_partition_spec(ndim, mesh)
+    sharded_axes = [d for d in (0, 1) if counts[d] > 1]
+
+    shells = None
+    if overlap and sharded_axes:
+        from ..ops.pallas.fused import build_overlap_shell_calls
+
+        shells = build_overlap_shell_calls(
+            stencil, local_shape, gshape, k, sharded_axes,
+            interpret=interpret, periodic=periodic)
+
+    def _origins():
+        # this shard's global (z, y) origin of the UNPADDED block —
+        # the kernel derives the frame mask from these scalars
+        return jnp.array([
+            lax.axis_index(axis_names[d]) * local_shape[d]
+            if axis_names[d] else 0
+            for d in (0, 1)], dtype=jnp.int32)
 
     def local_step(fields: Fields) -> Fields:
         from .halo import exchange_pad_axis
@@ -361,44 +441,128 @@ def make_sharded_fused_step(
             padded.append(f)
         args = [p for p in padded for _ in range(4)]
         if not periodic:
-            # this shard's global (z, y) origin of the UNPADDED block —
-            # the kernel derives the frame mask from these scalars
-            origins = jnp.array([
-                lax.axis_index(axis_names[d]) * local_shape[d]
-                if axis_names[d] else 0
-                for d in (0, 1)], dtype=jnp.int32)
-            args = [origins] + args
+            args = [_origins()] + args
         return tuple(call(*args))
 
-    return shard_map(
-        local_step,
-        mesh=mesh,
-        in_specs=(spec,),
-        out_specs=spec,
-        check_vma=False,
+    if shells is None:
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=spec,
+            check_vma=False,
+        )
+
+    def local_interior(fields: Fields):
+        # LOCAL bc/wrap pad only — no ppermute anywhere on this path, so
+        # XLA can run the exchange concurrently with this kernel.  Valid
+        # everywhere >= m from a sharded face; the pad rows feeding the
+        # rest are overwritten by the shells.
+        from .halo import exchange_pad_axis
+
+        local_padded = []
+        for f, bc in zip(fields, stencil.bc_value):
+            for d in (0, 1):
+                f = exchange_pad_axis(f, d, None, 1, m, bc,
+                                      periodic=periodic)
+            local_padded.append(f)
+        args = [p for p in local_padded for _ in range(4)]
+        if not periodic:
+            args = [_origins()] + args
+        return tuple(call(*args))
+
+    w = 2 * m
+
+    def local_step_overlap(fields: Fields) -> Fields:
+        from .halo import exchange_pad_axis
+
+        with jax.named_scope("halo_exchange"):
+            # issued first, consumed only by the shell calls below
+            padded = []
+            for f, bc in zip(fields, stencil.bc_value):
+                for d in (0, 1):
+                    f = exchange_pad_axis(
+                        f, d, axis_names[d], counts[d], m, bc,
+                        periodic=periodic)
+                padded.append(f)
+        with jax.named_scope("interior_update"):
+            out = list(local_interior(fields))
+        with jax.named_scope("boundary_update"):
+            origins = None if periodic else _origins()
+            for d in sharded_axes:
+                L = local_shape[d]
+                for lo in (True, False):
+                    # padded strip spanning global rows [o-m, o+3m) of
+                    # axis d, where o is the shell core's origin — the
+                    # exchanged slab + the 3m-deep local strip, with the
+                    # OTHER axis's (exchanged or local) pad attached
+                    strips = [
+                        _axis_slice(p, d, slice(0, 2 * w) if lo
+                                    else slice(p.shape[d] - 2 * w, None))
+                        for p in padded
+                    ]
+                    args = [s for s in strips for _ in range(4)]
+                    if not periodic:
+                        off = [0, 0]
+                        off[d] = 0 if lo else L - w
+                        args = [origins + jnp.array(off, jnp.int32)] + args
+                    shell_out = shells[d](*args)
+                    sl = slice(0, w) if lo else slice(L - w, None)
+                    for i in range(nfields):
+                        out[i] = out[i].at[
+                            (slice(None),) * d + (sl,)].set(shell_out[i])
+        return tuple(out)
+
+    return _attach_overlap(
+        shard_map(local_step_overlap, mesh=mesh, in_specs=(spec,),
+                  out_specs=spec, check_vma=False),
+        shard_map(local_interior, mesh=mesh, in_specs=(spec,),
+                  out_specs=spec, check_vma=False),
     )
 
 
 def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
                              axis_names, counts, k, build_call, layout,
-                             interpret, periodic):
+                             interpret, periodic, overlap=False):
     """shard_map wrapper for the z-slab pad-free fused kernels: width-m
     slab exchange (no concatenation, no padded copy), slabs handed to the
     kernel as operands, frame from SMEM origin scalars.  ``layout`` is
     (core views, slab views) per field — (9, 3) for the whole-row kernel,
-    (27, 9) for the wide-X variant."""
+    (27, 9) for the wide-X variant, (1, 1) for the streaming kernel.
+
+    ``overlap=True``: the exchanged slabs feed ONLY the width-``2m``
+    boundary-shell calls; the kernel's own slab operands are replaced by
+    LOCAL dummies (bc fill / local wrap — no ppermute dependency), so its
+    output is the overlap interior, valid ``>= m`` from the shard's z
+    faces, and the shells are spliced over it.  No exchange-padded copy
+    is materialized in either mode (the kinds exist for the 4096^3
+    budget); falls back to the plain step when the shell geometry does
+    not fit (local z < 3m)."""
     from ..ops.pallas.fused import _halo_per_micro
 
     n_core, n_slab = layout
     m = k * _halo_per_micro(stencil)
-    built = build_call(stencil, local_shape,
-                       tuple(int(g) for g in global_shape), k,
+    gshape = tuple(int(g) for g in global_shape)
+    built = build_call(stencil, local_shape, gshape, k,
                        interpret=interpret, periodic=periodic)
     if built is None:
         return None
     call, m_built, nfields = built
     assert m_built == m
     spec = grid_partition_spec(3, mesh)
+
+    shells = None
+    if overlap and counts[0] > 1:
+        from ..ops.pallas.fused import build_overlap_shell_calls
+
+        shells = build_overlap_shell_calls(
+            stencil, local_shape, gshape, k, (0,),
+            interpret=interpret, periodic=periodic)
+
+    def _origins():
+        return jnp.array([
+            lax.axis_index(axis_names[0]) * local_shape[0]
+            if axis_names[0] else 0, 0], dtype=jnp.int32)
 
     def local_step(fields: Fields) -> Fields:
         from .halo import exchange_slabs_axis
@@ -408,17 +572,77 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
             lo, hi = exchange_slabs_axis(
                 f, 0, axis_names[0], counts[0], m, bc, periodic=periodic)
             args += [f] * n_core + [lo] * n_slab + [hi] * n_slab
-        origins = jnp.array([
-            lax.axis_index(axis_names[0]) * local_shape[0]
-            if axis_names[0] else 0, 0], dtype=jnp.int32)
-        return tuple(call(origins, *args))
+        return tuple(call(_origins(), *args))
 
-    return shard_map(
-        local_step,
-        mesh=mesh,
-        in_specs=(spec,),
-        out_specs=spec,
-        check_vma=False,
+    if shells is None:
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=spec,
+            check_vma=False,
+        )
+
+    Lz = local_shape[0]
+    w = 2 * m
+
+    def local_interior(fields: Fields):
+        # the kernel's slab operands are LOCAL dummies (what a 1-shard
+        # exchange would produce): no ppermute on this path; its edge-m
+        # output rows are garbage and overwritten by the shells
+        from .halo import exchange_slabs_axis
+
+        args = []
+        for f, bc in zip(fields, stencil.bc_value):
+            dlo, dhi = exchange_slabs_axis(f, 0, None, 1, m, bc,
+                                           periodic=periodic)
+            args += [f] * n_core + [dlo] * n_slab + [dhi] * n_slab
+        return tuple(call(_origins(), *args))
+
+    def local_step_overlap(fields: Fields) -> Fields:
+        from .halo import exchange_pad_axis, exchange_slabs_axis
+
+        with jax.named_scope("halo_exchange"):
+            slabs = [
+                exchange_slabs_axis(f, 0, axis_names[0], counts[0], m, bc,
+                                    periodic=periodic)
+                for f, bc in zip(fields, stencil.bc_value)
+            ]
+        with jax.named_scope("interior_update"):
+            out = list(local_interior(fields))
+        with jax.named_scope("boundary_update"):
+            lo_args, hi_args = [], []
+            for (lo, hi), f, bc in zip(slabs, fields, stencil.bc_value):
+                strip_lo = jnp.concatenate(
+                    [lo, _axis_slice(f, 0, slice(0, 3 * m))], axis=0)
+                strip_hi = jnp.concatenate(
+                    [_axis_slice(f, 0, slice(Lz - 3 * m, None)), hi],
+                    axis=0)
+                # y is whole on every shard (z-only kinds): local pad
+                strip_lo = exchange_pad_axis(strip_lo, 1, None, 1, m, bc,
+                                             periodic=periodic)
+                strip_hi = exchange_pad_axis(strip_hi, 1, None, 1, m, bc,
+                                             periodic=periodic)
+                lo_args += [strip_lo] * 4
+                hi_args += [strip_hi] * 4
+            if periodic:
+                lo_out = shells[0](*lo_args)
+                hi_out = shells[0](*hi_args)
+            else:
+                org = _origins()
+                lo_out = shells[0](org, *lo_args)
+                hi_out = shells[0](
+                    org + jnp.array([Lz - w, 0], jnp.int32), *hi_args)
+            for i in range(nfields):
+                out[i] = out[i].at[:w].set(lo_out[i])
+                out[i] = out[i].at[Lz - w:].set(hi_out[i])
+        return tuple(out)
+
+    return _attach_overlap(
+        shard_map(local_step_overlap, mesh=mesh, in_specs=(spec,),
+                  out_specs=spec, check_vma=False),
+        shard_map(local_interior, mesh=mesh, in_specs=(spec,),
+                  out_specs=spec, check_vma=False),
     )
 
 
@@ -429,6 +653,7 @@ def make_sharded_fullgrid_step(
     k: int,
     interpret: Optional[bool] = None,
     periodic: bool = False,
+    overlap: bool = False,
 ):
     """2D temporal blocking under row decomposition: k steps per exchange.
 
@@ -446,6 +671,15 @@ def make_sharded_fullgrid_step(
     (global==local parity for red-black models, ops/sor.py caveat);
     local rows >= m (halo slabs stay single-neighbor); padded block
     within the VMEM budget.
+
+    ``overlap=True``: communication-overlapped split, exactly the 3D
+    scheme of ``make_sharded_fused_step`` in one dimension fewer — the
+    width-``m`` row-slab ``ppermute``s feed only two width-``2m``
+    shell instances of the same whole-block kernel (origin scalar offset
+    per shell), while the interior instance consumes a locally-padded
+    block.  Bit-exact vs ``overlap=False`` (the 2D kernel is exact —
+    int Life included).  Falls back to the plain step when local rows
+    < 3m.
     """
     from ..ops.pallas.fullgrid import build_fullgrid_masked_call
 
@@ -474,6 +708,24 @@ def make_sharded_fullgrid_step(
     assert nfields == stencil.num_fields
     spec = grid_partition_spec(ndim, mesh)
 
+    shell_call = None
+    if overlap and counts[0] > 1 and local_shape[0] >= 3 * m:
+        # width-2m shell instances of the same whole-block kernel: padded
+        # extent 4m = the exchanged slab (m) + a 3m-deep local strip
+        shell_built = build_fullgrid_masked_call(
+            stencil, (4 * m, local_shape[1]), m, k,
+            interpret=interpret, periodic=periodic,
+            global_shape=global_shape)
+        if shell_built is not None:
+            shell_call = shell_built[0]
+
+    def _origin(row0):
+        return jnp.array([row0], dtype=jnp.int32)
+
+    def _y0():
+        return lax.axis_index(axis_names[0]) * local_shape[0] \
+            if axis_names[0] else 0
+
     def local_step(fields: Fields) -> Fields:
         from .halo import exchange_pad_axis
 
@@ -488,17 +740,65 @@ def make_sharded_fullgrid_step(
             return tuple(call(*padded))
         # shard's global y-origin of the UNPADDED block, as an SMEM
         # scalar — the kernel derives the frame mask from it
-        y0 = lax.axis_index(axis_names[0]) * local_shape[0] \
-            if axis_names[0] else 0
-        origin = jnp.array([y0], dtype=jnp.int32)
-        return tuple(call(origin, *padded))
+        return tuple(call(_origin(_y0()), *padded))
 
-    return shard_map(
-        local_step,
-        mesh=mesh,
-        in_specs=(spec,),
-        out_specs=spec,
-        check_vma=False,
+    if shell_call is None:
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=spec,
+            check_vma=False,
+        )
+
+    Ly = local_shape[0]
+    w = 2 * m
+
+    def local_interior(fields: Fields):
+        # local pad only: no ppermute on the interior's dependency path
+        from .halo import exchange_pad_axis
+
+        padded = [
+            exchange_pad_axis(f, 0, None, 1, m, bc, periodic=periodic)
+            for f, bc in zip(fields, stencil.bc_value)
+        ]
+        if periodic:
+            return tuple(call(*padded))
+        return tuple(call(_origin(_y0()), *padded))
+
+    def local_step_overlap(fields: Fields) -> Fields:
+        from .halo import exchange_slabs_axis
+
+        with jax.named_scope("halo_exchange"):
+            slabs = [
+                exchange_slabs_axis(f, 0, axis_names[0], counts[0], m, bc,
+                                    periodic=periodic)
+                for f, bc in zip(fields, stencil.bc_value)
+            ]
+        with jax.named_scope("interior_update"):
+            out = list(local_interior(fields))
+        with jax.named_scope("boundary_update"):
+            lo_in = [jnp.concatenate([lo, f[:3 * m]], axis=0)
+                     for (lo, _), f in zip(slabs, fields)]
+            hi_in = [jnp.concatenate([f[Ly - 3 * m:], hi], axis=0)
+                     for (_, hi), f in zip(slabs, fields)]
+            if periodic:
+                lo_out = shell_call(*lo_in)
+                hi_out = shell_call(*hi_in)
+            else:
+                y0 = _y0()
+                lo_out = shell_call(_origin(y0), *lo_in)
+                hi_out = shell_call(_origin(y0 + Ly - w), *hi_in)
+            for i in range(nfields):
+                out[i] = out[i].at[:w].set(lo_out[i])
+                out[i] = out[i].at[Ly - w:].set(hi_out[i])
+        return tuple(out)
+
+    return _attach_overlap(
+        shard_map(local_step_overlap, mesh=mesh, in_specs=(spec,),
+                  out_specs=spec, check_vma=False),
+        shard_map(local_interior, mesh=mesh, in_specs=(spec,),
+                  out_specs=spec, check_vma=False),
     )
 
 
@@ -510,6 +810,7 @@ def make_sharded_temporal_step(
     interpret: Optional[bool] = None,
     periodic: bool = False,
     kind: Optional[str] = None,
+    overlap: bool = False,
 ):
     """Temporal blocking under decomposition, any dimensionality.
 
@@ -520,11 +821,15 @@ def make_sharded_temporal_step(
     Returns None when the (stencil, mesh, shape, k) combination is
     unsupported by the applicable builder.  ``kind="stream"`` (3D,
     z-only meshes) forces the sliding-window streaming kernel.
+    ``overlap=True`` selects the communication-overlapped interior/
+    boundary split in every kind that hosts it (falls back to the plain
+    exchange-then-compute step where the geometry declines — check
+    ``getattr(step, "_overlap_active", False)``).
     """
     if stencil.ndim == 2:
         return None if kind else make_sharded_fullgrid_step(
             stencil, mesh, global_shape, k, interpret=interpret,
-            periodic=periodic)
+            periodic=periodic, overlap=overlap)
     return make_sharded_fused_step(
         stencil, mesh, global_shape, k, interpret=interpret,
-        periodic=periodic, kind=kind)
+        periodic=periodic, kind=kind, overlap=overlap)

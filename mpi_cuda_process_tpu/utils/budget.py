@@ -62,6 +62,7 @@ def estimate_run_bytes(
     periodic: bool = False,
     compute: str = "auto",
     fuse_kind: str = "auto",
+    overlap: bool = False,
 ) -> Tuple[int, List[Tuple[str, int]]]:
     """Peak per-device live bytes for a run, with a labeled breakdown.
 
@@ -118,8 +119,13 @@ def estimate_run_bytes(
                 stencil, local, tuple(int(g) for g in grid), fuse,
                 interpret=True, periodic=periodic) is not None
             slab_b = batch * 2 * m * ly * lx * itemsize * nfields
+            if overlap:
+                # dummy interior slabs + the two 4m-row shell strips live
+                # alongside the exchanged slabs during the split
+                slab_b *= 2
             parts.append(
-                (f"sharded streaming: slab operands only (2x{m} rows)"
+                (f"sharded streaming: slab operands only (2x{m} rows"
+                 f"{', x2 overlap split' if overlap else ''})"
                  if ok else
                  "sharded streaming: UNBUILDABLE for this shape (the run "
                  "refuses before allocating)", slab_b if ok else 0))
@@ -134,16 +140,23 @@ def estimate_run_bytes(
             # z-slab pad-free (stepper._make_zslab_padfree_step): the
             # exchanged slabs are the ONLY transient — no padded copy
             slab_b = batch * 2 * m * ly * lx * itemsize * nfields
+            if overlap:
+                slab_b *= 2  # dummy interior slabs + shell strips
             parts.append(
-                (f"sharded pad-free: slab operands only (2x{m} rows)",
+                (f"sharded pad-free: slab operands only (2x{m} rows"
+                 f"{', x2 overlap split' if overlap else ''})",
                  slab_b))
         elif sharded:
             # exchange-padded local block per field (stepper.py
             # local_step); the frame comes from SMEM origin scalars, so
             # no mask array exists (round 3 streamed one per step)
+            n_padded = 2 * nfields if overlap else nfields
+            # overlap split: the exchange-padded block (shell inputs) and
+            # the locally-padded block (interior input) are live together
             parts.append(
-                (f"sharded fused: {nfields} exchange-padded block(s) "
-                 f"(+{2 * m} z/y)", nfields * padded_b))
+                (f"sharded fused: {n_padded} "
+                 f"{'exchange+local' if overlap else 'exchange'}-padded "
+                 f"block(s) (+{2 * m} z/y)", n_padded * padded_b))
         elif fuse_kind == "stream":
             # sliding-window manual-DMA kernel: the ring lives in VMEM,
             # HBM holds only state + output.  Probe construction (pure
@@ -236,6 +249,7 @@ def check_budget(
     compute: str = "auto",
     fuse_kind: str = "auto",
     hbm_bytes: Optional[int] = None,
+    overlap: bool = False,
 ) -> Tuple[int, List[Tuple[str, int]]]:
     """Raise ValueError with the arithmetic when the run cannot fit.
 
@@ -244,7 +258,8 @@ def check_budget(
     hbm = hbm_bytes if hbm_bytes is not None else device_hbm_bytes()
     total, parts = estimate_run_bytes(
         stencil, grid, mesh=mesh, fuse=fuse, ensemble=ensemble,
-        periodic=periodic, compute=compute, fuse_kind=fuse_kind)
+        periodic=periodic, compute=compute, fuse_kind=fuse_kind,
+        overlap=overlap)
     if total > hbm:
         raise ValueError(
             f"config needs ~{total / 2**30:.2f} GiB per device but HBM is "

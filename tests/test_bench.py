@@ -117,6 +117,28 @@ def test_scaling_fused_smoke(scaling, capsys):
         assert rec["mcells_per_s"] > 0
 
 
+@pytest.mark.slow
+def test_scaling_fused_overlap_ab_rows(scaling, capsys):
+    """--fuse K --overlap: the communication-overlap A/B ladder — rows
+    carry overlap=true and price the split stepper (rungs whose geometry
+    declines the split are skipped, never silently run plain)."""
+    import jax
+
+    n = len(jax.devices())
+    rc = scaling.main([
+        "--mode", "weak", "--stencil", "heat3d", "--block", "32,16,128",
+        "--steps", "2", "--reps", "1", "--fuse", "4", "--overlap",
+        "--virtual", str(n),
+    ])
+    assert rc == 0
+    recs = [json.loads(l) for l in capsys.readouterr().out.splitlines() if l]
+    sharded = [r for r in recs if max(r["mesh"]) > 1]
+    assert sharded, "overlap A/B mode emitted no sharded rows"
+    for rec in sharded:
+        assert rec["fuse"] == 4 and rec["overlap"] is True
+        assert rec["mcells_per_s"] > 0
+
+
 def test_stale_fallback_replays_only_local_measurements(bench, tmp_path):
     """Round-3 advisor (medium): a fresh checkout with a wedged backend
     must NOT replay VCS data as a value.  Only a cache record written by a

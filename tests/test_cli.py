@@ -272,6 +272,23 @@ def test_fuse_rejects_bad_configs():
         build(RunConfig(stencil="life", grid=(16, 16), iters=8, fuse=4))
 
 
+def test_fuse_overlap_mesh_matches_plain_run():
+    """--fuse K + --mesh + --overlap: the communication-overlapped split
+    composes at the CLI layer and changes no values."""
+    base = dict(stencil="heat3d", grid=(32, 16, 128), iters=8,
+                init="random", seed=2)
+    plain, _ = run(RunConfig(**base))
+    over, _ = run(RunConfig(**base, fuse=4, mesh=(2, 1, 1), overlap=True))
+    np.testing.assert_allclose(
+        np.asarray(over[0]), np.asarray(plain[0]), rtol=0, atol=1e-4)
+
+
+def test_fuse_overlap_requires_mesh():
+    with pytest.raises(ValueError, match="overlap"):
+        build(RunConfig(stencil="heat3d", grid=(32, 16, 128), iters=8,
+                        fuse=4, overlap=True))
+
+
 def test_fuse_kind_stream_matches_plain_run():
     """--fuse K --fuse-kind stream (sliding-window manual-DMA kernel) must
     agree with the plain run to the fused-window tolerance."""

@@ -8,6 +8,7 @@ it changes only the dependency structure, never the values.
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from mpi_cuda_process_tpu import (
@@ -51,7 +52,10 @@ def test_overlap_matches_unsharded(name, grid, mesh_shape, params):
         ref = ref_step(ref)
 
     mesh = make_mesh(mesh_shape)
-    step = make_sharded_step(st, mesh, grid, overlap=True)
+    # jit once: the un-jitted shard_map re-lowers on every call, which
+    # made this 39 s of pure re-trace (round-6 tier-1 timing); every real
+    # caller runs the step under jit (driver.make_runner)
+    step = jax.jit(make_sharded_step(st, mesh, grid, overlap=True))
     got = shard_fields(fields, mesh, st.ndim)
     for _ in range(5):
         got = step(got)
@@ -68,8 +72,9 @@ def test_overlap_periodic_matches_plain():
     st = make_stencil("life")
     g = np.random.default_rng(3).integers(0, 2, (8, 8)).astype(np.int32)
     mesh = make_mesh((2, 2))
-    plain = make_sharded_step(st, mesh, (8, 8), periodic=True)
-    over = make_sharded_step(st, mesh, (8, 8), periodic=True, overlap=True)
+    plain = jax.jit(make_sharded_step(st, mesh, (8, 8), periodic=True))
+    over = jax.jit(make_sharded_step(st, mesh, (8, 8), periodic=True,
+                                     overlap=True))
     fp = shard_fields((jnp.asarray(g),), mesh, 2)
     fo = shard_fields((jnp.asarray(g),), mesh, 2)
     for _ in range(4):

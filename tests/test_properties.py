@@ -17,8 +17,13 @@ Invariants checked across randomly drawn configurations:
 
 import numpy as np
 import pytest
-from hypothesis import HealthCheck, given, settings
-from hypothesis import strategies as hs
+
+# Optional dependency: absent in some CI images.  Skip the module as ONE
+# named skip instead of dying as a collection error (the same discipline
+# as tests/test_compat.py for pltpu drift).
+pytest.importorskip("hypothesis")
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as hs  # noqa: E402
 
 import jax.numpy as jnp
 
