@@ -66,7 +66,9 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
     whole-grid-in-VMEM temporal blocking) | shfusedK / overlapK (sharded
     fused step over a z-only mesh of ALL devices, K steps per width-m
     exchange — overlapK adds the communication-overlapped interior/
-    boundary split; needs >= 2 devices) | copy (harness-calibration
+    boundary split; needs >= 2 devices; a ``_meshZxY`` suffix pins a
+    2-axis (Z, Y, 1) mesh instead — the two-axis pad-free A/B against
+    the z-ring, needs Z*Y devices) | copy (harness-calibration
     1R+1W elementwise scan).
     """
     kw = dict(params or {})
@@ -125,19 +127,44 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
         )
 
         ov = compute.startswith("overlap")
-        step_unit, tiles = _parse_kspec(
-            compute[len("overlap" if ov else "shfused"):])
+        spec = compute[len("overlap" if ov else "shfused"):]
+        mesh_zy = None
+        if "_mesh" in spec:
+            # _meshZxY: a pinned 2-axis (Z, Y, 1) mesh — the A/B row
+            # against the all-devices z-ring (surface-to-volume cuts
+            # face bytes; the 2-axis pad-free kernels keep the path
+            # transient-free)
+            spec, meshspec = spec.split("_mesh", 1)
+            mz, my = meshspec.split("x", 1)
+            mesh_zy = (int(mz), int(my))
+        step_unit, tiles = _parse_kspec(spec)
         if tiles is not None:
             raise ValueError("sharded fused labels take no tile spec")
         n_dev = len(jax.devices())
-        if n_dev < 2:
+        need = mesh_zy[0] * mesh_zy[1] if mesh_zy else 2
+        if n_dev < need:
             # environmental, not structural: retried on every run so the
             # first healthy multi-chip session prices these labels
             raise ValueError(
-                f"sharded fused labels need >= 2 devices (have {n_dev})")
-        mesh = make_mesh((n_dev, 1, 1))
+                f"sharded fused labels need >= {need} devices "
+                f"(have {n_dev})")
+        mesh = make_mesh((mesh_zy[0], mesh_zy[1], 1) if mesh_zy
+                         else (n_dev, 1, 1))
+        # 2-axis rows force the pad-free slab-operand kernels: at 512^3
+        # the local block is below the auto pad-free threshold, and the
+        # point of the _mesh labels is to price the NEW kernel class
+        # (y-slab + corner operands) on a real chip, not the padded
+        # kernel on a different topology
         step = make_sharded_fused_step(st, mesh, grid, step_unit,
-                                       overlap=ov)
+                                       overlap=ov,
+                                       padfree=True if mesh_zy else None)
+        if mesh_zy and step is not None and \
+                not str(getattr(step, "_padfree_kind", "")).startswith(
+                    "yzslab"):
+            raise ValueError(
+                "2-axis label did not build the yz-slab pad-free kernel "
+                f"(got {getattr(step, '_padfree_kind', None)!r}) — must "
+                "not price a different kernel under this label")
         if step is None:
             raise ValueError(
                 f"untileable sharded fused k={step_unit} for {grid} on "
@@ -432,6 +459,22 @@ CONFIGS = [
      "shfused4"),
     ("wave3d_512_f32_overlap4", "wave3d", (512, 512, 512), 8, "float32",
      "overlap4"),
+    # D8 (round 7): TWO-AXIS decomposition A/B — the same k/grid as the
+    # z-ring rows above, on a pinned 8x8x1 mesh (needs a 64-chip slice;
+    # fast environmental decline + retry elsewhere).  Surface-to-volume
+    # cuts face bytes ~8x vs 64x1x1 (STATE.md ICI arithmetic, item 6),
+    # and the 2-axis pad-free kernels (fused.build_yzslab_padfree_call:
+    # y-slab + corner operands) keep the path transient-free — these
+    # rows decide whether the decomposition shape is chosen by
+    # measurement instead of kernel availability.
+    ("heat3d_512_f32_shfused4_mesh8x8", "heat3d", (512, 512, 512), 10,
+     "float32", "shfused4_mesh8x8"),
+    ("heat3d_512_f32_overlap4_mesh8x8", "heat3d", (512, 512, 512), 10,
+     "float32", "overlap4_mesh8x8"),
+    ("wave3d_512_f32_shfused4_mesh8x8", "wave3d", (512, 512, 512), 8,
+     "float32", "shfused4_mesh8x8"),
+    ("wave3d_512_f32_overlap4_mesh8x8", "wave3d", (512, 512, 512), 8,
+     "float32", "overlap4_mesh8x8"),
 ]
 
 # Tier-D labels: new large Mosaic compiles.  A hang here is plausibly a
@@ -452,7 +495,7 @@ _RISKY = frozenset(
 # gate, new kernel variant).  Cached untileable declines from an older
 # builder are retried instead of skipped — tileability is a property of the
 # CODE, not the config (round-3 advisor finding).
-BUILDER_REV = 5
+BUILDER_REV = 6
 
 
 def _skip_cached(cached):

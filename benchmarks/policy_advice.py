@@ -177,7 +177,12 @@ def _resolve_suspects(table):
             if abs(mc2 - mc) > 0.15 * max(mc, 1e-9):
                 trusted, repl = mc2, n150  # judge the rerun instead
         if trusted * 8 / 1e3 > _HBM_PEAK_GBS:  # 1R+1W f32 GB/s
-            del entries["jnp"]
+            # remove EVERY jnp-prefixed entry: jnp_n150 also matches the
+            # ('jnp', 'raw', 'pallas') baseline prefixes in _best, so a
+            # physically impossible rerun would otherwise keep serving
+            # as the family's single-step baseline (ADVICE.md r5 medium)
+            for compute in [c for c in entries if c.startswith("jnp")]:
+                del entries[compute]
             rows.append(("advect3d suspect",
                          "STILL >roofline — jnp excluded as a policy "
                          "baseline", ev))
@@ -287,12 +292,23 @@ def advise(table):
             continue
         wins = [a[2] > b[2] for _, a, b in rows]
         k = _winning_k(rows)
-        kind = "stream" if rows[-1][1][0].startswith("stream") \
-            else "tiled/padfree"
+        # the winning KIND must be consistent at every measured size,
+        # exactly like _winning_k's rule for k — deriving it from only
+        # the largest-size row would name a kind family-wide even though
+        # it lost at a measured size (ADVICE.md r5 low)
+        kinds = {"stream" if a[0].startswith("stream") else "tiled/padfree"
+                 for _, a, _ in rows}
+        kind = kinds.pop() if len(kinds) == 1 else None
         if all(wins):
-            rec = (f"{family}: k={k} via {kind}" if k else
-                   f"{family}: blocking wins but k varies by size — "
-                   "per-size policy needed")
+            if k and kind:
+                rec = f"{family}: k={k} via {kind}"
+            elif k:
+                rec = (f"{family}: blocking wins at k={k} but the "
+                       "winning kind is MIXED across sizes — per-size "
+                       "kind policy needed")
+            else:
+                rec = (f"{family}: blocking wins but k varies by size — "
+                       "per-size policy needed")
         elif not any(wins):
             rec = f"{family}: keep jnp"
         else:

@@ -226,6 +226,14 @@ def main(argv=None) -> int:
                         "width-k exchange (weak/strong modes; meshes keep "
                         "the lane axis whole — untileable rungs are "
                         "skipped)")
+    p.add_argument("--mesh-axes", type=int, default=2, choices=[1, 2],
+                   help="sharded-fused rung mesh arity (3D --fuse "
+                        "ladders): 2 = balanced (z, y, 1) rungs "
+                        "(default — the surface-to-volume-minimizing "
+                        "decomposition, now pad-free via the 2-axis "
+                        "slab-operand kernels); 1 = z-only (n, 1, 1) "
+                        "rungs — run both for the decomposition-shape "
+                        "A/B against the same grid")
     a = p.parse_args(argv)
     # --fuse + --overlap now composes: the temporal-blocked steppers carry
     # their own interior/boundary split (stepper.make_sharded_fused_step
@@ -265,7 +273,11 @@ def main(argv=None) -> int:
     ladder = _mesh_ladder(n_devices, st.ndim)
     if a.fuse > 1 and st.ndim == 3:
         # sharded-fused keeps the lane axis whole: decompose z/y only
-        ladder = [(*m2, 1) for m2 in _mesh_ladder(n_devices, 2)]
+        # (--mesh-axes 1 pins the z-ring for the decomposition-shape A/B)
+        if a.mesh_axes == 1:
+            ladder = [(m1[0], 1, 1) for m1 in _mesh_ladder(n_devices, 1)]
+        else:
+            ladder = [(*m2, 1) for m2 in _mesh_ladder(n_devices, 2)]
     elif a.fuse > 1 and st.ndim == 2:
         # 2D whole-local-block kernel: row decomposition only
         ladder = _mesh_ladder(n_devices, 1)
@@ -300,6 +312,7 @@ def main(argv=None) -> int:
             "mode": a.mode, "stencil": a.stencil,
             "overlap": a.overlap, "fuse": a.fuse,
             "fuse_kind": a.fuse_kind,
+            "mesh_axes": a.mesh_axes,
             "mesh": list(mesh_shape), "grid": list(global_shape),
             "mcells_per_s": round(mcells, 1),
             "mcells_per_s_per_device": round(per_dev, 1),

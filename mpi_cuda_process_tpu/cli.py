@@ -120,13 +120,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "--periodic, and --tol)")
     p.add_argument("--fuse-kind", default="auto",
                    choices=["auto", "tiled", "padfree", "stream"],
-                   help="which 3D fused kernel carries --fuse (unsharded "
-                        "runs): tiled = padded 4-block windows; padfree = "
-                        "9-block raw-grid (no pad transient, 1024^3-class "
-                        "grids); stream = sliding-window manual-DMA "
+                   help="which 3D fused kernel carries --fuse: tiled = "
+                        "padded 4-block windows (unsharded); padfree = "
+                        "9-block raw-grid, no pad transient (unsharded "
+                        "1024^3-class grids; under --mesh, the "
+                        "slab-operand kernels on z-only AND 2-axis z/y "
+                        "meshes — exchanged slabs + corner pieces as "
+                        "operands); stream = sliding-window manual-DMA "
                         "pipeline (every plane read once per pass; bf16 "
-                        "works at k=4); auto = the measured default "
-                        "(padfree above the HBM threshold, else tiled)")
+                        "works at k=4; z-only meshes); auto = the "
+                        "measured default (padfree above the HBM "
+                        "threshold, else tiled)")
     p.add_argument("--mem-check", default="error",
                    choices=["error", "warn", "off"],
                    help="per-device HBM budget guard (TPU runs): estimate "
@@ -410,18 +414,21 @@ def build(cfg: RunConfig):
                 "unsharded run has no exchange to overlap")
         if cfg.fuse_kind != "auto" and (
                 st.ndim == 2
-                or (use_mesh and cfg.fuse_kind != "stream")):
+                or (use_mesh and cfg.fuse_kind not in ("stream",
+                                                       "padfree"))):
             raise ValueError(
                 "--fuse-kind selects the 3D kernel variant; 2D grids use "
                 "the whole-grid VMEM kernel, and sharded runs support "
-                "only 'stream' (the exchange-composed tiled kernels are "
-                "'auto')")
+                "'stream' (z-only meshes) and 'padfree' (z-only and "
+                "2-axis z/y meshes — the slab-operand kernels); the "
+                "exchange-composed tiled kernels are 'auto'")
         if use_mesh:
             # k fused steps per width-k*halo exchange (the 4096^3-class
             # configuration: decomposition AND temporal blocking); 2D
             # grids use the whole-local-block VMEM kernel under a row
             # decomposition (the reference's own 1-D split, k-amortized)
-            kind = cfg.fuse_kind if cfg.fuse_kind == "stream" else None
+            kind = cfg.fuse_kind if cfg.fuse_kind in ("stream",
+                                                      "padfree") else None
             fused = stepper_lib.make_sharded_temporal_step(
                 st, m, cfg.grid, cfg.fuse, periodic=cfg.periodic,
                 kind=kind, overlap=cfg.overlap)
@@ -435,10 +442,14 @@ def build(cfg: RunConfig):
             if fused is None:
                 raise ValueError(
                     f"--fuse {cfg.fuse} + --mesh {cfg.mesh}"
-                    + (" --fuse-kind stream" if kind else "")
+                    + (f" --fuse-kind {kind}" if kind else "")
                     + f" unsupported for {st.name} on {cfg.grid}: needs a "
                     f"fused kernel, an unsharded lane axis"
-                    + (", a z-only mesh, guard-frame BCs" if kind else "")
+                    + (", a z-only mesh, guard-frame BCs"
+                       if kind == "stream" else "")
+                    + (", a slab-operand kernel that tiles the local "
+                       "block (no padded fallback under a forced kind)"
+                       if kind == "padfree" else "")
                     + ", aligned per-shard extents, and blocks >= the "
                     "k-step margin")
         elif st.ndim == 2:

@@ -508,6 +508,188 @@ def _zslab_specs(Lz, Y, X, bz, by, m, periodic):
     return core, slab
 
 
+def _assemble_yz_window(blocks, iz, jy, nz_tiles, ny_tiles):
+    """Assemble one (bz+4m, by+4m, X') window with slab selects on BOTH
+    wall axes — the 2-axis generalization of ``_fused_zslab_kernel``'s
+    z-only selects (STATE.md round-4 open avenue 5).
+
+    ``blocks`` is 25 loaded blocks of one field at one x-position:
+    9 core views (3x3 pre/core/post in z and y, BOTH axes clamped — wall
+    values are replaced by the selects below), 3 y-views of the lower
+    z-slab, 3 of the upper, 3 z-views of the lower y-slab (operands
+    pre-DUPLICATED to 2m columns: cols [-2m, -m) land on don't-care rows,
+    [-m, 0) on the genuine slab), 3 of the upper, and the 4 corner pieces
+    (also 2m-duplicated; ll/lh/hl/hh in (z-side, y-side) order — the
+    two-pass-composed diagonal-neighbor data, ``halo.exchange_slabs_2axis``).
+
+    Placement argument, per wall: the window's outer 2m rows/cols at a
+    shard face decompose as m don't-care (outside even the exchange
+    width — temporal validity never reads them into a surviving cell)
+    + m genuine slab rows/cols, so ``concat([slab_row, slab_row])`` in z
+    and the 2m-duplicated operands in y put real values exactly where
+    validity needs them.  At a corner program both substitutions apply:
+    the z-wall row's y-tail is replaced by the corner piece (not the
+    z-slab's clamped y view), so the (z±, y±) ghost quadrant holds the
+    diagonal neighbor's block.  Unsharded axes receive bc-fill/wrap
+    dummy slabs from the caller, which is exactly what a local pad
+    would supply — one assembly serves every mesh shape.
+    """
+    core, zlo = blocks[:9], blocks[9:12]
+    zhi, ylo = blocks[12:15], blocks[15:18]
+    yhi, corners = blocks[18:21], blocks[21:25]
+    c_ll, c_lh, c_hl, c_hh = corners
+    at_ylo, at_yhi = jy == 0, jy == ny_tiles - 1
+    rows = []
+    for r in range(3):
+        pre = jnp.where(at_ylo, ylo[r], core[3 * r])
+        post = jnp.where(at_yhi, yhi[r], core[3 * r + 2])
+        rows.append(jnp.concatenate([pre, core[3 * r + 1], post], axis=1))
+
+    def zrow(zv, c_lo, c_hi):
+        pre = jnp.where(at_ylo, c_lo, zv[0])
+        post = jnp.where(at_yhi, c_hi, zv[2])
+        return jnp.concatenate([pre, zv[1], post], axis=1)
+
+    row_lo = zrow(zlo, c_ll, c_lh)
+    row_hi = zrow(zhi, c_hl, c_hh)
+    pre = jnp.where(iz == 0,
+                    jnp.concatenate([row_lo, row_lo], axis=0), rows[0])
+    post = jnp.where(iz == nz_tiles - 1,
+                     jnp.concatenate([row_hi, row_hi], axis=0), rows[2])
+    return jnp.concatenate([pre, rows[1], post], axis=0)
+
+
+def _fused_yzslab_kernel(micro, nfields, k, margin, halo, bz, by, gshape,
+                         periodic, parity, nz_tiles, ny_tiles, interpret,
+                         *refs):
+    """Sharded PAD-FREE kernel for (z, y)-decomposed meshes.
+
+    Like ``_fused_zslab_kernel`` but with slab selects on BOTH wall axes
+    plus the 4 two-pass-composed corner operands — 2D meshes stop paying
+    the exchange-padded HBM copy (the last pad transient on 2-axis
+    decompositions).  ``refs``: an SMEM (2,) int32 global-origin scalar
+    first, then per field the 25 views ``_assemble_yz_window`` documents,
+    then ``nfields`` outputs.  Frame/parity from origins + program ids,
+    exactly the z-slab kernel's scheme (origins now carry BOTH axes'
+    shard offsets).
+    """
+    wm = 2 * margin
+    origins, refs = refs[0], refs[1:]
+    per = 25
+    iz, jy = pl.program_id(0), pl.program_id(1)
+    fields = tuple(
+        _assemble_yz_window([r[...] for r in refs[per * f:per * f + per]],
+                            iz, jy, nz_tiles, ny_tiles)
+        for f in range(nfields))
+    like = fields[0]
+    outs = refs[per * nfields:]
+    frame, extra = _window_frame(
+        like.shape, origins[0] + iz * bz - wm, origins[1] + jy * by - wm,
+        gshape, halo, periodic, parity)
+    fields = _run_micros(micro, fields, frame, extra, k)
+    for o, f in zip(outs, fields):
+        o[...] = f[wm:bz + wm, wm:by + wm, :]
+
+
+def _yzslab_specs(Lz, Y, X, bz, by, m):
+    """25 per-field specs for the 2-axis pad-free kernel: 9 core views
+    (BOTH axes clamped — every wall is a slab-selected shard face or a
+    frame-re-pinned global wall), 3 y-views per z-slab ((m, ·, X): the
+    m-row extent is the MAJOR axis, no sublane constraint), 3 z-views
+    per y-slab (operand pre-duplicated to 2m columns so the block's
+    sublane extent is ``2m`` — tile-aligned by ``_tiles_valid``'s gate —
+    instead of the unaligned ``m``), and 4 corner views (same 2m
+    duplication)."""
+    g = 2 * m
+    zp, zn = _tail_index_fns(Lz, bz, g, wrap=False)
+    yp, yn = _tail_index_fns(Y, by, g, wrap=False)
+    core = _raw_window_specs(Lz, Y, X, bz, by, m,
+                             wrap_z=False, wrap_y=False)
+    zslab = [
+        pl.BlockSpec((m, g, X), lambda i, j: (0, yp(j), 0)),
+        pl.BlockSpec((m, by, X), lambda i, j: (0, j, 0)),
+        pl.BlockSpec((m, g, X), lambda i, j: (0, yn(j), 0)),
+    ]
+    yslab = [
+        pl.BlockSpec((g, g, X), lambda i, j: (zp(i), 0, 0)),
+        pl.BlockSpec((bz, g, X), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((g, g, X), lambda i, j: (zn(i), 0, 0)),
+    ]
+    corner = [pl.BlockSpec((m, g, X), lambda i, j: (0, 0, 0))
+              for _ in range(4)]
+    return core + zslab + zslab + yslab + yslab + corner
+
+
+def build_yzslab_padfree_call(
+    stencil: Stencil,
+    local_shape: Tuple[int, int, int],
+    global_shape: Tuple[int, int, int],
+    k: int,
+    tiles: Optional[Tuple[int, int]] = None,
+    interpret: Optional[bool] = None,
+    periodic: bool = False,
+):
+    """Sharded pad-free fused call for (z, y)-decomposed meshes.
+
+    The call takes: origins (int32 (2,): this shard's global z AND y
+    block offsets), then per field 9 views of the raw LOCAL block +
+    3 views of each z-slab + 3 views of each (2m-duplicated) y-slab +
+    the 4 (2m-duplicated) corner pieces, and returns ``nfields``
+    local-shape arrays advanced k steps.  Returns
+    ``(call, margin, nfields)`` or None.
+
+    Why this exists: every pad-free kind was z-mesh-only, so a 2-axis
+    mesh silently fell back to the exchange-padded step — forfeiting the
+    communication-minimizing balanced decomposition (arXiv:2108.11076's
+    surface-to-volume argument: an 8x8x1 mesh cuts config-5 face bytes
+    ~8x vs 64x1x1) unless the operator accepted the pad transient.  The
+    corner operands follow the portable-collective redistribution
+    pattern (slabs of slabs, arXiv:2112.01075) rather than a diagonal
+    ppermute.
+    """
+    if not fused_supported(stencil):
+        return None
+    if interpret is None:
+        interpret = _interpret_default()
+    micro_factory, halo, nfields = _MICRO[stencil.name]
+    margin = k * _halo_per_micro(stencil)
+    Lz, Y, X = (int(s) for s in local_shape)
+    gz, gy, gx = (int(s) for s in global_shape)
+    if stencil.parity_sensitive and periodic and (gx % 2 or gy % 2
+                                                  or gz % 2):
+        return None
+    itemsize = jnp.dtype(stencil.dtype).itemsize
+    if tiles is None:
+        tiles = _pick_tiles(Lz, Y, X, margin, itemsize, nfields,
+                            wm=2 * margin)
+    if tiles is None:
+        return None
+    bz, by = tiles
+    if not _tiles_valid(Lz, Y, bz, by, margin, itemsize):
+        return None
+    micro = micro_factory(stencil, interpret)
+    grid = (Lz // bz, Y // by)
+    per_field = _yzslab_specs(Lz, Y, X, bz, by, margin)
+    out_spec = pl.BlockSpec((bz, by, X), lambda i, j: (i, j, 0))
+    call = pl.pallas_call(
+        functools.partial(
+            _fused_yzslab_kernel, micro, nfields, k, margin, halo, bz, by,
+            (gz, gy, gx), periodic, stencil.parity_sensitive, Lz // bz,
+            Y // by, interpret),
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + per_field * nfields,
+        out_specs=[out_spec] * nfields,
+        out_shape=[jax.ShapeDtypeStruct((Lz, Y, X), stencil.dtype)
+                   for _ in range(nfields)],
+        interpret=interpret,
+        compiler_params=None if interpret else compiler_params(
+            vmem_limit_bytes=_VMEM_LIMIT_BYTES,
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )
+    return call, margin, nfields
+
+
 _XWIN_GX = 128  # x-margin/granularity: one lane tile (>= any margin m)
 
 
@@ -700,6 +882,148 @@ def _xwin_specs(Lz, Y, X, bz, by, bx, m, periodic):
                 (lambda yf=yf, xf=xf:
                  lambda i, j, l: (0, yf(j), xf(l)))()))
     return core, slab
+
+
+def _yzslab_xwin_specs(Lz, Y, X, bz, by, bx, m, periodic):
+    """Per-field specs for the wide-X 2-axis kernel: the 25-view group of
+    ``_yzslab_specs`` instantiated at each of the three x-positions
+    (pre/core/post, x-tails one lane tile, clamped/wrapped at the
+    always-global x walls) — 75 views per field, x-position-major so the
+    kernel assembles each sub-window with the SAME 2-axis select logic
+    and concatenates along x."""
+    g = 2 * m
+    gx = _XWIN_GX
+    zp, zn = _tail_index_fns(Lz, bz, g, wrap=False)
+    yp, yn = _tail_index_fns(Y, by, g, wrap=False)
+    xp, xn = _tail_index_fns(X, bx, gx, wrap=periodic)
+    zpos = [(g, zp), (bz, lambda i: i), (g, zn)]
+    ypos = [(g, yp), (by, lambda j: j), (g, yn)]
+    xpos = [(gx, xp), (bx, lambda l: l), (gx, xn)]
+    specs = []
+    for xs, xf in xpos:
+        core = []
+        for zs, zf in zpos:
+            for ys, yf in ypos:
+                core.append(pl.BlockSpec(
+                    (zs, ys, xs),
+                    (lambda zf=zf, yf=yf, xf=xf:
+                     lambda i, j, l: (zf(i), yf(j), xf(l)))()))
+        zslab = [pl.BlockSpec(
+            (m, ys, xs),
+            (lambda yf=yf, xf=xf:
+             lambda i, j, l: (0, yf(j), xf(l)))())
+            for ys, yf in ypos]
+        yslab = [pl.BlockSpec(
+            (zs, g, xs),
+            (lambda zf=zf, xf=xf:
+             lambda i, j, l: (zf(i), 0, xf(l)))())
+            for zs, zf in zpos]
+        corner = [pl.BlockSpec(
+            (m, g, xs),
+            (lambda xf=xf: lambda i, j, l: (0, 0, xf(l)))())
+            for _ in range(4)]
+        specs += core + zslab + zslab + yslab + yslab + corner
+    return specs
+
+
+def _fused_yzslab_xwin_kernel(micro, nfields, k, margin, halo, bz, by, bx,
+                              gshape, periodic, parity, nz_tiles, ny_tiles,
+                              interpret, *refs):
+    """Wide-X variant of ``_fused_yzslab_kernel``: the lane axis is
+    windowed at ``_XWIN_GX``-lane granularity for grids whose whole-row
+    windows exceed VMEM (two-field wave3d at X=4096 on an 8x8x1 mesh —
+    the config-5 2-axis gap).  Each of the three x-positions is a full
+    ``_assemble_yz_window`` (both-axis slab/corner selects), concatenated
+    in x; lane-roll wrap garbage lands in the GX-lane x shell, which the
+    output inset excludes (GX >= m, gated)."""
+    wm = 2 * margin
+    gx = _XWIN_GX
+    origins, refs = refs[0], refs[1:]
+    per = 75
+    iz, jy = pl.program_id(0), pl.program_id(1)
+    fields = []
+    for f in range(nfields):
+        base = per * f
+        subs = []
+        for t in range(3):
+            b = refs[base + 25 * t:base + 25 * t + 25]
+            subs.append(_assemble_yz_window(
+                [r[...] for r in b], iz, jy, nz_tiles, ny_tiles))
+        fields.append(jnp.concatenate(subs, axis=2))
+    fields = tuple(fields)
+    like = fields[0]
+    outs = refs[per * nfields:]
+    frame, extra = _window_frame(
+        like.shape, origins[0] + iz * bz - wm, origins[1] + jy * by - wm,
+        gshape, halo, periodic, parity, x0=pl.program_id(2) * bx - gx)
+    fields = _run_micros(micro, fields, frame, extra, k)
+    for o, f in zip(outs, fields):
+        o[...] = f[wm:bz + wm, wm:by + wm, gx:bx + gx]
+
+
+def build_yzslab_xwin_call(
+    stencil: Stencil,
+    local_shape: Tuple[int, int, int],
+    global_shape: Tuple[int, int, int],
+    k: int,
+    tiles: Optional[Tuple[int, int, int]] = None,
+    interpret: Optional[bool] = None,
+    periodic: bool = False,
+):
+    """Wide-X sharded pad-free fused call for (z, y)-decomposed meshes —
+    the fallback when ``build_yzslab_padfree_call``'s whole-row windows
+    exceed VMEM (wide X x multi-field), symmetric to the z-only
+    ``build_zslab_xwin_call``.  The call takes origins (int32 (2,)), then
+    per field the 75 views of ``_yzslab_xwin_specs`` (pass the block 27x,
+    each z-slab 9x, each 2m-duplicated y-slab 9x, each 2m-duplicated
+    corner 3x — x-position-major 25-groups), and returns ``nfields``
+    local-shape arrays advanced k steps.  Returns
+    ``(call, margin, nfields)`` or None."""
+    if not fused_supported(stencil):
+        return None
+    if interpret is None:
+        interpret = _interpret_default()
+    micro_factory, halo, nfields = _MICRO[stencil.name]
+    margin = k * _halo_per_micro(stencil)
+    if _XWIN_GX < margin:
+        return None  # x shell must absorb the full validity margin
+    Lz, Y, X = (int(s) for s in local_shape)
+    gz, gy, gxx = (int(s) for s in global_shape)
+    if stencil.parity_sensitive and periodic and (gxx % 2 or gy % 2
+                                                  or gz % 2):
+        return None
+    itemsize = jnp.dtype(stencil.dtype).itemsize
+    if tiles is None:
+        tiles = _pick_xwin_tiles(Lz, Y, X, margin, itemsize, nfields)
+    if tiles is None:
+        return None
+    bz, by, bx = tiles
+    if bx >= X:
+        return None  # whole-row windows: use the plain 2-axis kernel
+    if not _tiles_valid(Lz, Y, bz, by, margin, itemsize) \
+            or X % bx or bx % _XWIN_GX:
+        return None
+    micro = micro_factory(stencil, interpret)
+    grid = (Lz // bz, Y // by, X // bx)
+    per_field = _yzslab_xwin_specs(Lz, Y, X, bz, by, bx, margin, periodic)
+    out_spec = pl.BlockSpec((bz, by, bx), lambda i, j, l: (i, j, l))
+    call = pl.pallas_call(
+        functools.partial(
+            _fused_yzslab_xwin_kernel, micro, nfields, k, margin, halo,
+            bz, by, bx, (gz, gy, gxx), periodic,
+            stencil.parity_sensitive, Lz // bz, Y // by, interpret),
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + per_field * nfields,
+        out_specs=[out_spec] * nfields,
+        out_shape=[jax.ShapeDtypeStruct((Lz, Y, X), stencil.dtype)
+                   for _ in range(nfields)],
+        interpret=interpret,
+        compiler_params=None if interpret else compiler_params(
+            vmem_limit_bytes=_VMEM_LIMIT_BYTES,
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+    )
+    return call, margin, nfields
 
 
 def build_zslab_padfree_call(

@@ -85,6 +85,45 @@ def exchange_slabs_axis(
     return from_left, from_right
 
 
+def exchange_slabs_2axis(
+    x: jax.Array,
+    axis_names: Sequence[Optional[str]],
+    shard_counts: Sequence[int],
+    halo: int,
+    bc_value,
+    periodic: bool = False,
+) -> Tuple[Tuple[jax.Array, jax.Array],
+           Tuple[jax.Array, jax.Array],
+           Tuple[jax.Array, jax.Array, jax.Array, jax.Array]]:
+    """Slab set for BOTH wall axes of a 3D block, corners by composition.
+
+    The operand set the 2-axis pad-free kernels consume
+    (``fused.build_yzslab_padfree_call``): the four face slabs of grid
+    axes 0 (z) and 1 (y) plus the four ``(halo, halo, X)`` corner pieces,
+    all UNconcatenated — no exchange-padded copy of the block is ever
+    materialized.  Corners ride the same two-pass axis-wise scheme as
+    ``exchange_and_pad`` (SURVEY.md §7.3.2): the y-exchange OF the
+    z-slabs transports diagonal-neighbor data with face-only transfers —
+    shard (z, y)'s ``c_ll`` is shard (z-1, y-1)'s trailing corner block,
+    having hopped z then y.  An unsharded axis (name ``None`` / count 1)
+    degrades to the local bc-fill / wrap slabs, so the same operand set
+    serves (z, y)-, y-only-, and z-only-sharded meshes.
+
+    Returns ``((zlo, zhi), (ylo, yhi), (c_ll, c_lh, c_hl, c_hh))`` with
+    corner order (z-side, y-side): ll = (z-lo, y-lo), lh = (z-lo, y-hi),
+    hl = (z-hi, y-lo), hh = (z-hi, y-hi).
+    """
+    zlo, zhi = exchange_slabs_axis(
+        x, 0, axis_names[0], shard_counts[0], halo, bc_value, periodic)
+    ylo, yhi = exchange_slabs_axis(
+        x, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic)
+    c_ll, c_lh = exchange_slabs_axis(
+        zlo, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic)
+    c_hl, c_hh = exchange_slabs_axis(
+        zhi, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic)
+    return (zlo, zhi), (ylo, yhi), (c_ll, c_lh, c_hl, c_hh)
+
+
 def exchange_pad_axis(
     x: jax.Array,
     axis: int,

@@ -303,13 +303,21 @@ def make_sharded_fused_step(
     window), so the per-field-halo elision that applies to single steps
     does not apply here.
 
-    ``padfree`` (z-only decompositions): hand the exchanged slabs to the
-    kernel as separate operands instead of materializing the exchange-
-    padded local block (``fused.build_zslab_padfree_call``) — the padded
-    block was the last full-size transient in the 4096^3 budget.
-    ``None`` = auto: pad-free when the padded copies would exceed the
-    same HBM threshold the single-chip path uses (``prefer_padfree`` on
-    the local block), padded (the measured configuration) below it.
+    ``padfree``: hand the exchanged slabs to the kernel as separate
+    operands instead of materializing the exchange-padded local block —
+    the padded block was the last full-size transient in the 4096^3
+    budget.  z-only meshes take the measured z-slab kernels
+    (``fused.build_zslab_padfree_call``, wide-X fallback); meshes that
+    shard y take the 2-axis kernels (``fused.build_yzslab_padfree_call``,
+    wide-X fallback): y slabs + the four two-pass-composed corner
+    operands per field, selects on both wall axes — so the balanced
+    (surface-to-volume-minimizing) decompositions stop paying the pad
+    transient.  ``None`` = auto: pad-free when the padded copies would
+    exceed the same HBM threshold the single-chip path uses
+    (``prefer_padfree`` on the local block), padded (the measured
+    configuration) below it.  ``kind="padfree"`` forces it with NO
+    padded fallback (returns None when no pad-free builder tiles the
+    shape — a forced kind must never silently run the padded kernel).
 
     ``kind="stream"`` forces the sliding-window streaming kernel
     (ops/pallas/streamfused.py, z-only meshes, guard-frame): slab
@@ -346,11 +354,11 @@ def make_sharded_fused_step(
     )
 
     ndim = stencil.ndim
-    if kind not in (None, "stream"):
+    if kind not in (None, "stream", "padfree"):
         # a typo'd or unsupported kind must not silently measure the
         # auto-selected kernel under the wrong label
         raise ValueError(f"unknown sharded fused kind {kind!r} "
-                         "(None=auto, 'stream')")
+                         "(None=auto, 'stream', 'padfree')")
     if ndim != 3 or not fused_supported(stencil):
         return None
     axis_names, counts = _resolve_mesh_axes(ndim, mesh)
@@ -373,28 +381,41 @@ def make_sharded_fused_step(
             stencil, mesh, global_shape, local_shape, axis_names, counts,
             k, build_stream_sharded_call, (1, 1), interpret, periodic,
             overlap=overlap)
+    forced_padfree = kind == "padfree"
+    if forced_padfree:
+        padfree = True
     if padfree is None:
-        padfree = z_only and prefer_padfree(stencil, local_shape)
-    if padfree and z_only:
-        step = _make_zslab_padfree_step(
-            stencil, mesh, global_shape, local_shape, axis_names, counts,
-            k, build_zslab_padfree_call, (9, 3), interpret, periodic,
-            overlap=overlap)
-        if step is None:
-            # whole-row windows exceed VMEM (wide X x multi-field): the
-            # wide-X kernel windows the lane axis too
-            from ..ops.pallas.fused import build_zslab_xwin_call
-
+        padfree = prefer_padfree(stencil, local_shape)
+    if padfree:
+        if z_only:
             step = _make_zslab_padfree_step(
                 stencil, mesh, global_shape, local_shape, axis_names,
-                counts, k, build_zslab_xwin_call, (27, 9), interpret,
+                counts, k, build_zslab_padfree_call, (9, 3), interpret,
                 periodic, overlap=overlap)
+            if step is None:
+                # whole-row windows exceed VMEM (wide X x multi-field):
+                # the wide-X kernel windows the lane axis too
+                from ..ops.pallas.fused import build_zslab_xwin_call
+
+                step = _make_zslab_padfree_step(
+                    stencil, mesh, global_shape, local_shape, axis_names,
+                    counts, k, build_zslab_xwin_call, (27, 9), interpret,
+                    periodic, overlap=overlap)
+        else:
+            # y (or y+z) sharded: the 2-axis slab-operand kernels — y
+            # slabs + two-pass-composed corner operands, selects on both
+            # wall axes; 2D meshes no longer pay the pad transient
+            step = _make_yzslab_padfree_step(
+                stencil, mesh, global_shape, local_shape, axis_names,
+                counts, k, interpret, periodic, overlap=overlap)
         if step is not None:
             return step
-        # both pad-free builders declined: fall through to the padded
+        if forced_padfree:
+            # a FORCED kind must never silently measure the padded
+            # kernel under a pad-free label: callers (cli) raise
+            return None
+        # the pad-free builders declined: fall through to the padded
         # kernel rather than turning a previously-working config into None
-    # (padfree requested but mesh shards y too: same padded fallback —
-    # the clamp/slab trick needs whole y on every shard)
     # Periodic keeps frame identically False (no origins needed): wrap
     # halos arrive via the exchange, and parity stays globally consistent
     # because shard origins/extents are even (alignment gates).  The
@@ -549,6 +570,10 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
         return None
     call, m_built, nfields = built
     assert m_built == m
+    # introspection label for tests/tools: which slab-operand kernel
+    # actually carries the step (the builders silently fall back)
+    kind_name = {(9, 3): "zslab", (27, 9): "zslab_xwin",
+                 (1, 1): "stream"}[layout]
     spec = grid_partition_spec(3, mesh)
 
     shells = None
@@ -575,13 +600,15 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
         return tuple(call(_origins(), *args))
 
     if shells is None:
-        return shard_map(
+        step = shard_map(
             local_step,
             mesh=mesh,
             in_specs=(spec,),
             out_specs=spec,
             check_vma=False,
         )
+        step._padfree_kind = kind_name
+        return step
 
     Lz = local_shape[0]
     w = 2 * m
@@ -638,12 +665,189 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
                 out[i] = out[i].at[Lz - w:].set(hi_out[i])
         return tuple(out)
 
-    return _attach_overlap(
+    step = _attach_overlap(
         shard_map(local_step_overlap, mesh=mesh, in_specs=(spec,),
                   out_specs=spec, check_vma=False),
         shard_map(local_interior, mesh=mesh, in_specs=(spec,),
                   out_specs=spec, check_vma=False),
     )
+    step._padfree_kind = kind_name
+    return step
+
+
+def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
+                              axis_names, counts, k, interpret, periodic,
+                              overlap=False):
+    """shard_map wrapper for the 2-AXIS pad-free fused kernels
+    (y-sharded and y+z-sharded meshes): width-m slab exchange on both
+    wall axes plus the four corner pieces by two-pass composition
+    (``halo.exchange_slabs_2axis``), everything handed to the kernel as
+    operands — no exchange-padded copy on 2-axis meshes (the transient
+    the padded fallback used to pay, ~4 GiB-class for config 5 at
+    4x4x4).  Falls back whole-row -> wide-X; an unsharded axis (z on a
+    (1, ny, 1) mesh) receives local bc/wrap dummy slabs from the same
+    exchange helper, so one wrapper serves every non-z-only mesh shape.
+
+    ``overlap=True``: the exchanged slabs/corners feed ONLY the
+    width-``2m`` boundary-shell calls (one lo+hi pair per sharded axis,
+    ``fused.build_overlap_shell_calls``); the kernel's own slab operands
+    are replaced by LOCAL dummies, so its output is the overlap
+    interior, and the shells — whose input strips are assembled from
+    slab + 3m local strip with the OTHER axis's exchanged slab/corner
+    values as padding (edge strips included: a z-shell's y tails carry
+    genuine corner data) — are spliced over it.  Falls back to the
+    plain step when any sharded local extent is < 3m."""
+    from ..ops.pallas.fused import (
+        _halo_per_micro,
+        build_yzslab_padfree_call,
+        build_yzslab_xwin_call,
+    )
+
+    m = k * _halo_per_micro(stencil)
+    gshape = tuple(int(g) for g in global_shape)
+    kind_name = "yzslab"
+    built = build_yzslab_padfree_call(stencil, local_shape, gshape, k,
+                                      interpret=interpret,
+                                      periodic=periodic)
+    xrep = 1
+    if built is None:
+        # whole-row windows exceed VMEM (wide X x multi-field): window
+        # the lane axis too — each x-position repeats the 25-view group
+        built = build_yzslab_xwin_call(stencil, local_shape, gshape, k,
+                                       interpret=interpret,
+                                       periodic=periodic)
+        kind_name, xrep = "yzslab_xwin", 3
+    if built is None:
+        return None
+    call, m_built, nfields = built
+    assert m_built == m
+    spec = grid_partition_spec(3, mesh)
+    names2 = (axis_names[0], axis_names[1])
+    counts2 = (counts[0], counts[1])
+    sharded_axes = [d for d in (0, 1) if counts[d] > 1]
+
+    shells = None
+    if overlap and sharded_axes:
+        from ..ops.pallas.fused import build_overlap_shell_calls
+
+        shells = build_overlap_shell_calls(
+            stencil, local_shape, gshape, k, sharded_axes,
+            interpret=interpret, periodic=periodic)
+
+    def _origins():
+        return jnp.array([
+            lax.axis_index(axis_names[d]) * local_shape[d]
+            if axis_names[d] else 0
+            for d in (0, 1)], dtype=jnp.int32)
+
+    def _dup_y(a):
+        # the y-slab/corner operands' sublane extent must be the
+        # tile-aligned 2m, not the unaligned m: duplicate along y — the
+        # first copy lands on don't-care window cells (see
+        # fused._assemble_yz_window), the second on the genuine ones
+        return jnp.concatenate([a, a], axis=1)
+
+    def _exchange(fields, names):
+        from .halo import exchange_slabs_2axis
+
+        return [exchange_slabs_2axis(f, names, counts2, m, bc,
+                                     periodic=periodic)
+                for f, bc in zip(fields, stencil.bc_value)]
+
+    def _kernel_args(fields, ex):
+        args = []
+        for f, ((zlo, zhi), (ylo, yhi), cs) in zip(fields, ex):
+            group = ([f] * 9 + [zlo] * 3 + [zhi] * 3
+                     + [_dup_y(ylo)] * 3 + [_dup_y(yhi)] * 3
+                     + [_dup_y(c) for c in cs])
+            args += group * xrep
+        return args
+
+    def local_step(fields: Fields) -> Fields:
+        ex = _exchange(fields, names2)
+        return tuple(call(_origins(), *_kernel_args(fields, ex)))
+
+    if shells is None:
+        step = shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=spec,
+            check_vma=False,
+        )
+        step._padfree_kind = kind_name
+        return step
+
+    Lz, Ly = local_shape[0], local_shape[1]
+    w = 2 * m
+
+    def local_interior(fields: Fields):
+        # LOCAL dummy slabs on both axes: no ppermute anywhere on this
+        # path; the edge-m output cells are garbage and overwritten by
+        # the shells
+        ex = _exchange(fields, (None, None))
+        return tuple(call(_origins(), *_kernel_args(fields, ex)))
+
+    def _shell_strip(f, ex_f, d, lo):
+        """Padded input strip of the axis-``d`` lo/hi boundary shell:
+        the exchanged slab + a 3m-deep local strip along ``d``, with the
+        OTHER axis's exchanged slab/corner values as the m-wide padding
+        (the edge strips the 2-axis split needs for exact corners)."""
+        (zlo, zhi), (ylo, yhi), (c_ll, c_lh, c_hl, c_hh) = ex_f
+        s3 = 3 * m
+        if d == 0:
+            if lo:
+                mid = jnp.concatenate([zlo, f[:s3]], axis=0)
+                left = jnp.concatenate([c_ll, ylo[:s3]], axis=0)
+                right = jnp.concatenate([c_lh, yhi[:s3]], axis=0)
+            else:
+                mid = jnp.concatenate([f[Lz - s3:], zhi], axis=0)
+                left = jnp.concatenate([ylo[Lz - s3:], c_hl], axis=0)
+                right = jnp.concatenate([yhi[Lz - s3:], c_hh], axis=0)
+            return jnp.concatenate([left, mid, right], axis=1)
+        if lo:
+            mid = jnp.concatenate([ylo, f[:, :s3]], axis=1)
+            top = jnp.concatenate([c_ll, zlo[:, :s3]], axis=1)
+            bot = jnp.concatenate([c_hl, zhi[:, :s3]], axis=1)
+        else:
+            mid = jnp.concatenate([f[:, Ly - s3:], yhi], axis=1)
+            top = jnp.concatenate([zlo[:, Ly - s3:], c_lh], axis=1)
+            bot = jnp.concatenate([zhi[:, Ly - s3:], c_hh], axis=1)
+        return jnp.concatenate([top, mid, bot], axis=0)
+
+    def local_step_overlap(fields: Fields) -> Fields:
+        with jax.named_scope("halo_exchange"):
+            # issued first, consumed only by the shell calls below
+            ex = _exchange(fields, names2)
+        with jax.named_scope("interior_update"):
+            out = list(local_interior(fields))
+        with jax.named_scope("boundary_update"):
+            origins = None if periodic else _origins()
+            for d in sharded_axes:
+                L = local_shape[d]
+                for lo in (True, False):
+                    strips = [_shell_strip(f, e, d, lo)
+                              for f, e in zip(fields, ex)]
+                    args = [s for s in strips for _ in range(4)]
+                    if not periodic:
+                        off = [0, 0]
+                        off[d] = 0 if lo else L - w
+                        args = [origins + jnp.array(off, jnp.int32)] + args
+                    shell_out = shells[d](*args)
+                    sl = slice(0, w) if lo else slice(L - w, None)
+                    for i in range(nfields):
+                        out[i] = out[i].at[
+                            (slice(None),) * d + (sl,)].set(shell_out[i])
+        return tuple(out)
+
+    step = _attach_overlap(
+        shard_map(local_step_overlap, mesh=mesh, in_specs=(spec,),
+                  out_specs=spec, check_vma=False),
+        shard_map(local_interior, mesh=mesh, in_specs=(spec,),
+                  out_specs=spec, check_vma=False),
+    )
+    step._padfree_kind = kind_name
+    return step
 
 
 def make_sharded_fullgrid_step(
@@ -820,7 +1024,9 @@ def make_sharded_temporal_step(
     care which kernel shape implements the k-steps-per-exchange strategy.
     Returns None when the (stencil, mesh, shape, k) combination is
     unsupported by the applicable builder.  ``kind="stream"`` (3D,
-    z-only meshes) forces the sliding-window streaming kernel.
+    z-only meshes) forces the sliding-window streaming kernel;
+    ``kind="padfree"`` (3D, any z/y mesh) forces the slab-operand
+    kernels with no padded fallback.
     ``overlap=True`` selects the communication-overlapped interior/
     boundary split in every kind that hosts it (falls back to the plain
     exchange-then-compute step where the geometry declines — check

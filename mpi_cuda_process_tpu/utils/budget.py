@@ -103,6 +103,55 @@ def estimate_run_bytes(
         lz, ly, lx = local
         padded_b = batch * (lz + 2 * m) * (ly + 2 * m) * lx * itemsize
         z_only = all(int(c) == 1 for c in tuple(mesh)[1:])
+        lane_whole = all(int(c) == 1 for c in tuple(mesh)[2:])
+
+        def _padfree_slab_part():
+            """(label, bytes) for the sharded slab-operand pad-free path
+            — z-only or 2-axis — or None when no builder tiles this
+            local shape (construction is pure Python, no compile)."""
+            if not lane_whole:
+                return None
+            grid_t = tuple(int(g) for g in grid)
+            if z_only:
+                ok = (build_zslab_padfree_call(
+                    stencil, local, grid_t, fuse,
+                    interpret=True, periodic=periodic) is not None
+                    or build_zslab_xwin_call(
+                        stencil, local, grid_t, fuse,
+                        interpret=True, periodic=periodic) is not None)
+                if not ok:
+                    return None
+                slab_cells = 2 * m * ly * lx
+                what = f"slab operands only (2x{m} rows"
+            else:
+                from ..ops.pallas.fused import (
+                    build_yzslab_padfree_call,
+                    build_yzslab_xwin_call,
+                )
+
+                ok = (build_yzslab_padfree_call(
+                    stencil, local, grid_t, fuse,
+                    interpret=True, periodic=periodic) is not None
+                    or build_yzslab_xwin_call(
+                        stencil, local, grid_t, fuse,
+                        interpret=True, periodic=periodic) is not None)
+                if not ok:
+                    return None
+                # z slabs (width m) + 2m-duplicated y-slab operands +
+                # the four 2m-duplicated corner pieces — the whole
+                # transient set; NO exchange-padded block on 2-axis
+                # meshes any more
+                slab_cells = (2 * m * ly * lx + 2 * (2 * m) * lz * lx
+                              + 4 * m * (2 * m) * lx)
+                what = f"slab+corner operands only (2-axis, width {m}"
+            slab_b = batch * slab_cells * itemsize * nfields
+            if overlap:
+                # dummy interior slabs + the shell strips live alongside
+                # the exchanged slabs during the split
+                slab_b *= 2
+            return (f"sharded pad-free: {what}"
+                    f"{', x2 overlap split' if overlap else ''})", slab_b)
+
         # The budget must describe the path the stepper will actually
         # take: a pad-free preference that the kernel builder cannot TILE
         # (the VMEM window gate at very wide X) falls back to the padded
@@ -129,23 +178,22 @@ def estimate_run_bytes(
                  if ok else
                  "sharded streaming: UNBUILDABLE for this shape (the run "
                  "refuses before allocating)", slab_b if ok else 0))
-        elif sharded and z_only and prefer_padfree(stencil, local,
-                                                   batch=batch) \
-                and (build_zslab_padfree_call(
-                    stencil, local, tuple(int(g) for g in grid), fuse,
-                    interpret=True, periodic=periodic) is not None
-                    or build_zslab_xwin_call(
-                        stencil, local, tuple(int(g) for g in grid), fuse,
-                        interpret=True, periodic=periodic) is not None):
-            # z-slab pad-free (stepper._make_zslab_padfree_step): the
-            # exchanged slabs are the ONLY transient — no padded copy
-            slab_b = batch * 2 * m * ly * lx * itemsize * nfields
-            if overlap:
-                slab_b *= 2  # dummy interior slabs + shell strips
-            parts.append(
-                (f"sharded pad-free: slab operands only (2x{m} rows"
-                 f"{', x2 overlap split' if overlap else ''})",
-                 slab_b))
+        elif sharded and fuse_kind == "padfree":
+            # forced pad-free under a mesh: no padded fallback exists
+            # (make_sharded_fused_step returns None and cli raises), so
+            # never estimate the padded transient
+            part = _padfree_slab_part()
+            parts.append(part if part is not None else (
+                "sharded pad-free: UNBUILDABLE for this mesh/shape — "
+                "no padded fallback under a forced kind (the run "
+                "refuses before allocating)", 0))
+        elif sharded and prefer_padfree(stencil, local, batch=batch) \
+                and _padfree_slab_part() is not None:
+            # slab-operand pad-free (stepper._make_zslab_padfree_step /
+            # _make_yzslab_padfree_step): the exchanged slabs (+ corner
+            # pieces on 2-axis meshes) are the ONLY transient — no
+            # padded copy
+            parts.append(_padfree_slab_part())
         elif sharded:
             # exchange-padded local block per field (stepper.py
             # local_step); the frame comes from SMEM origin scalars, so

@@ -4,7 +4,11 @@
 # that drops a flag is how "passes for me" diverges from "passes the
 # driver").  Runs the default-tier test suite on the CPU backend (8
 # virtual devices via tests/conftest.py) and prints the passed-dot count
-# the driver scores.
+# the driver scores.  Afterwards, the collection-count guard
+# (scripts/check_tier_counts.py) verifies pytest.ini's tier-counts line
+# against reality — the stale-count drift class cannot recur silently;
+# its failure fails this script too (the driver's raw ROADMAP command is
+# unaffected).
 #
 # Usage: bash scripts/tier1.sh   (from the repo root)
 set -o pipefail
@@ -15,4 +19,5 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+python scripts/check_tier_counts.py || rc=1
 exit $rc

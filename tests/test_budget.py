@@ -23,28 +23,55 @@ def _total(name, grid, mesh=(), fuse=0, ensemble=0, **kw):
     return total
 
 
-def test_config5_f32_refused_with_arithmetic():
-    """4096^3 wave f32 on 64 chips does NOT fit: 2x4 GiB state + exchange
-    transients ~27 GiB/device. The guard must say so, with numbers."""
+def test_config5_f32_jnp_refused_with_arithmetic():
+    """4096^3 wave f32 on 64 chips WITHOUT temporal blocking does not
+    fit (2x4 GiB state + 4 out + ~8 GiB exchange-padded jnp copies).
+    The guard must say so, with numbers."""
     st = make_stencil("wave3d")
     with pytest.raises(ValueError) as e:
-        budget.check_budget(st, (4096,) * 3, mesh=(8, 8, 1), fuse=4,
+        budget.check_budget(st, (4096,) * 3, mesh=(8, 8, 1),
                             hbm_bytes=V5E_HBM)
     msg = str(e.value)
     assert "GiB per device" in msg and "state: 2 field(s)" in msg
     assert "bfloat16" in msg  # the actionable lever is named
 
 
+def test_config5_f32_two_axis_mesh_padfree_fits():
+    """The 2-axis headline budget row (docs/STATE.md table): wave3d
+    4096^3 in FULL f32 on an 8x8x1 mesh FITS at fuse 4 — the 2-axis
+    pad-free kernels (y-slab + corner operands) replace the ~8 GiB
+    exchange-padded transient that used to push this config past HBM,
+    and the estimate follows the constructible path (the wide-X 2-axis
+    builder actually tiles wave at 4096 lanes).  Pinned to the byte:
+    2x4 GiB state + 4 GiB out + 0.379 GiB slab+corner operands, +10%."""
+    st = make_stencil("wave3d")
+    total, parts = budget.check_budget(st, (4096,) * 3, mesh=(8, 8, 1),
+                                       fuse=4, hbm_bytes=V5E_HBM)
+    # independent arithmetic (not the module's own constants)
+    lz, ly, lx, m, item, nf = 512, 512, 4096, 4, 4, 2
+    state = 2 * lz * ly * lx * item
+    out = lz * ly * lx * item
+    slabs = (2 * m * ly * lx            # z slabs
+             + 2 * (2 * m) * lz * lx    # 2m-duplicated y-slab operands
+             + 4 * m * (2 * m) * lx     # 2m-duplicated corner pieces
+             ) * item * nf
+    assert total == int((state + out + slabs) * 1.10) == 14_620_924_313
+    assert any("pad-free" in label for label, _ in parts)
+    assert not any("pad transient" in label for label, _ in parts)
+    assert not any("exchange" in label for label, _ in parts)
+
+
 def test_config5_bf16_fits():
-    """bf16 halves everything: ~11.3 GiB/device at k=8 (state 4 + out 2 +
-    exchange-padded blocks 4.25 + overhead) — the designed config-5
-    execution (SURVEY.md §7.3.3; table in docs/STATE.md)."""
+    """bf16 at k=8 on the 8x8x1 mesh: ~7.0 GiB/device (state 4 + out 2 +
+    0.38 GiB slab+corner operands + overhead) — the 2-axis pad-free path
+    replaced the round-5 exchange-padded estimate (11.3 GiB)."""
     st = make_stencil("wave3d", dtype="bfloat16")
-    total, _ = budget.check_budget(st, (4096,) * 3, mesh=(8, 8, 1), fuse=8,
-                                   hbm_bytes=V5E_HBM)
-    # pinned tight: a regression reinflating the estimate (e.g. the mask
-    # array coming back) must fail here, not drift inside a loose range
-    assert 10.5 * GiB < total < 12 * GiB
+    total, parts = budget.check_budget(st, (4096,) * 3, mesh=(8, 8, 1),
+                                       fuse=8, hbm_bytes=V5E_HBM)
+    # pinned tight: a regression reinflating the estimate (e.g. the pad
+    # transient coming back) must fail here, not drift in a loose range
+    assert 6.8 * GiB < total < 7.3 * GiB
+    assert any("pad-free" in label for label, _ in parts)
 
 
 def test_1024_padfree_fits_padded_does_not_appear():

@@ -321,6 +321,24 @@ def test_fuse_kind_stream_with_mesh_matches_plain_run():
         np.asarray(stream[0]), np.asarray(plain[0]), rtol=0, atol=1e-4)
 
 
+def test_fuse_kind_padfree_with_two_axis_mesh_matches_plain_run():
+    """--fuse K --fuse-kind padfree --mesh z,y: the 2-axis slab-operand
+    kernels through the CLI — the lifted z-only gate (round 7).  The
+    forced kind must actually run pad-free (builder introspection), not
+    silently fall back to the exchange-padded kernel."""
+    base = dict(stencil="heat3d", grid=(16, 32, 128), iters=8,
+                init="random", seed=2)
+    plain, _ = run(RunConfig(**base))
+    st, step_fn, _, _ = build(RunConfig(**base, fuse=4,
+                                        fuse_kind="padfree",
+                                        mesh=(1, 2, 1)))
+    assert getattr(step_fn, "_padfree_kind", None) == "yzslab"
+    pf, _ = run(RunConfig(**base, fuse=4, fuse_kind="padfree",
+                          mesh=(1, 2, 1)))
+    np.testing.assert_allclose(
+        np.asarray(pf[0]), np.asarray(plain[0]), rtol=0, atol=1e-4)
+
+
 def test_config5_rehearsal_reduced_scale():
     """BASELINE config 5's exact command SHAPE at 1/64 scale: two-field
     wave3d, bf16, z-only 8-way mesh, --fuse 4 --fuse-kind stream,
@@ -363,10 +381,16 @@ def test_fuse_kind_rejects_bad_configs():
     with pytest.raises(ValueError, match="stream"):
         build(RunConfig(stencil="heat3d", grid=(48, 64, 128), iters=8,
                         fuse=4, fuse_kind="stream", mesh=(1, 2, 1)))
-    # the tiled kinds stay unsharded-only
+    # forced padfree under a mesh builds the slab-operand kernels with
+    # NO padded fallback: an untileable local block raises (local z = 4
+    # is below the 2m=8 tile granularity)
+    with pytest.raises(ValueError, match="padfree"):
+        build(RunConfig(stencil="heat3d", grid=(8, 16, 128), iters=8,
+                        fuse=4, fuse_kind="padfree", mesh=(2, 1, 1)))
+    # the padded tiled kind stays unsharded-only
     with pytest.raises(ValueError, match="fuse-kind"):
         build(RunConfig(stencil="heat3d", grid=(48, 32, 128), iters=8,
-                        fuse=4, fuse_kind="padfree", mesh=(2, 1, 1)))
+                        fuse=4, fuse_kind="tiled", mesh=(2, 1, 1)))
     with pytest.raises(ValueError, match="fuse-kind"):
         build(RunConfig(stencil="heat2d", grid=(64, 128), iters=8,
                         fuse=4, fuse_kind="tiled"))

@@ -349,17 +349,20 @@ def test_zslab_padfree_periodic_matches_unsharded():
     assert jnp.allclose(got[0], ref[0], rtol=0, atol=1e-4)
 
 
-def test_zslab_padfree_declines_y_sharded_mesh():
+def test_padfree_y_sharded_mesh_takes_two_axis_kernel():
     from mpi_cuda_process_tpu import make_mesh
     from mpi_cuda_process_tpu.parallel.stepper import make_sharded_fused_step
 
     st = make_stencil("heat3d")
-    # y sharded: the slab trick needs whole y; padfree=True falls back to
-    # the padded kernel rather than failing
+    # y sharded: padfree=True now builds the 2-AXIS slab-operand kernel
+    # (y slabs + corner operands) instead of silently falling back to
+    # the exchange-padded kernel (the pre-round-7 behavior; equivalence
+    # is pinned by tests/test_twoaxis_padfree.py)
     mesh = make_mesh((2, 2, 1))
     step = make_sharded_fused_step(st, mesh, (32, 32, 128), 4,
                                    interpret=True, padfree=True)
-    assert step is not None  # padded fallback
+    assert step is not None
+    assert getattr(step, "_padfree_kind", None) == "yzslab"
 
 
 # ---------------------------------------------------------------------------

@@ -114,6 +114,22 @@ def test_bf16_blocking_win_names_k_and_kind(P, tmp_path):
     assert adv2["_AUTO_FUSE_K_BF16"][0] == "heat3d: k=4 via stream"
 
 
+def test_bf16_mixed_kind_across_sizes_never_names_one_kind(P, tmp_path):
+    """Blocking wins at both sizes with the same k, but via padfree8 at
+    256^3 and stream8 at 512^3 — the advice must flag the kind as MIXED
+    instead of naming the largest-size winner family-wide (the old
+    rows[-1]-only derivation)."""
+    adv = _advice(P, tmp_path, {
+        "heat3d_256_bf16": _rec(35700),
+        "heat3d_256_bf16_padfree8": _rec(80000),
+        "heat3d_512_bf16": _rec(35700),
+        "heat3d_512_bf16_stream8": _rec(80000),
+    })
+    r, e = adv["_AUTO_FUSE_K_BF16"]
+    assert "MIXED" in r and "k=8" in r
+    assert "256^3" in e and "512^3" in e
+
+
 def test_bf16_loss_keeps_jnp(P, tmp_path):
     adv = _advice(P, tmp_path, {
         "heat3d_512_bf16": _rec(35700),
@@ -194,12 +210,21 @@ def test_advect_suspect_flagged_and_resolved(P, tmp_path):
     adv3 = _advice(P, tmp_path, {"advect3d_256_f32_jnp": _rec(60000)})
     assert adv3["advect3d suspect"][0].startswith("resolved")
     # a rerun that disagrees but is ITSELF above the roofline resolves
-    # nothing (120 Gcells/s f32 -> 960 GB/s implied > 819)
+    # nothing (120 Gcells/s f32 -> 960 GB/s implied > 819) — and with a
+    # fused label in the table, NEITHER jnp entry may keep serving as
+    # the single-step baseline: jnp_n150 also matches the baseline
+    # prefix in _best, so leaving it produced a 'keep single-step'
+    # verdict cited against a physically impossible number (ADVICE.md
+    # r5 medium).  The correct outcome is the explicit pending row.
     adv4 = _advice(P, tmp_path, {
         "advect3d_256_f32_jnp": _rec(150454),
         "advect3d_256_f32_jnp_n150": _rec(120000),
+        "advect3d_256_f32_fused4": _rec(45000),
     })
     assert adv4["advect3d suspect"][0].startswith("STILL")
+    r, e = adv4["_AUTO_FUSE_K"]
+    assert r == "advect3d: no measured comparison yet"
+    assert "single-step baseline" in e
 
 
 def test_copy_calibration_reports_rate(P, tmp_path):
