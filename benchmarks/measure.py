@@ -68,7 +68,10 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
     exchange — overlapK adds the communication-overlapped interior/
     boundary split; needs >= 2 devices; a ``_meshZxY`` suffix pins a
     2-axis (Z, Y, 1) mesh instead — the two-axis pad-free A/B against
-    the z-ring, needs Z*Y devices) | copy (harness-calibration
+    the z-ring, needs Z*Y devices) | streamK_shard / streamK_meshZxY
+    (the STREAMING kernel sharded: z-only mesh of all devices /
+    a pinned 2-axis mesh via the round-8 y-slab+corner splice — the
+    kind x mesh A/B rows) | copy (harness-calibration
     1R+1W elementwise scan).
     """
     kw = dict(params or {})
@@ -107,11 +110,57 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
             raise ValueError(f"untileable padfree k={step_unit} for {grid}")
     elif compute.startswith("stream"):
         # sliding-window manual-DMA temporal blocking: every input plane
-        # loaded ONCE per k-step pass (ops/pallas/streamfused.py)
+        # loaded ONCE per k-step pass (ops/pallas/streamfused.py).
+        # ``streamK_shard`` runs it SHARDED over a z-only mesh of all
+        # devices (slab operands); ``streamK_meshZxY`` pins a 2-axis
+        # (Z, Y, 1) mesh — the round-8 kernel class (y-slab + corner
+        # operands spliced into the sliding window), the A/B against the
+        # z-ring for the lowest-traffic kind.
+        spec = compute[len("stream"):]
+        mesh_zy = shard_all = None
+        if "_mesh" in spec:
+            spec, meshspec = spec.split("_mesh", 1)
+            mz, my = meshspec.split("x", 1)
+            mesh_zy = (int(mz), int(my))
+        elif spec.endswith("_shard"):
+            spec, shard_all = spec[:-len("_shard")], True
+        step_unit, tiles = _parse_kspec(spec)
+        if mesh_zy or shard_all:
+            if tiles is not None:
+                raise ValueError("sharded stream labels take no tile spec")
+            from mpi_cuda_process_tpu import make_mesh, shard_fields
+            from mpi_cuda_process_tpu.parallel.stepper import (
+                make_sharded_fused_step,
+            )
+
+            n_dev = len(jax.devices())
+            need = mesh_zy[0] * mesh_zy[1] if mesh_zy else 2
+            if n_dev < need:
+                # environmental, not structural: retried on every run
+                raise ValueError(
+                    f"sharded stream labels need >= {need} devices "
+                    f"(have {n_dev})")
+            mesh = make_mesh((mesh_zy[0], mesh_zy[1], 1) if mesh_zy
+                             else (n_dev, 1, 1))
+            step = make_sharded_fused_step(st, mesh, grid, step_unit,
+                                           kind="stream")
+            if step is None:
+                raise ValueError(
+                    f"untileable sharded stream k={step_unit} for {grid} "
+                    f"on mesh {tuple(mesh.shape.values())}")
+            if not str(getattr(step, "_padfree_kind", "")).startswith(
+                    "stream"):
+                raise ValueError(
+                    "sharded stream label did not build the streaming "
+                    f"kernel (got {getattr(step, '_padfree_kind', None)!r})"
+                    " — must not price a different kernel under this "
+                    "label")
+            mk = lambda: shard_fields(  # noqa: E731
+                init_state(st, grid, kind="auto"), mesh, st.ndim)
+            return _time_scan(step, mk, grid, steps, reps, step_unit)
         from mpi_cuda_process_tpu.ops.pallas.streamfused import (
             make_stream_fused_step,
         )
-        step_unit, tiles = _parse_kspec(compute[len("stream"):])
         step = make_stream_fused_step(st, grid, step_unit, tiles=tiles)
         if step is None:
             raise ValueError(f"untileable stream k={step_unit} for {grid}")
@@ -475,6 +524,25 @@ CONFIGS = [
      "float32", "shfused4_mesh8x8"),
     ("wave3d_512_f32_overlap4_mesh8x8", "wave3d", (512, 512, 512), 8,
      "float32", "overlap4_mesh8x8"),
+    # D9 (round 8): STREAMING x MESH — the sharded streaming kernel on
+    # the z-ring (all devices) vs the pinned balanced 8x8x1 mesh (the
+    # new 2-axis y-slab+corner splice class, needs a 64-chip slice;
+    # fast environmental decline + retry elsewhere).  With D8 these
+    # rows complete the kind x mesh measurement matrix: every kernel
+    # class now exists on both mesh families, so decomposition shape
+    # is chosen purely by these numbers.
+    ("heat3d_512_f32_stream4_shard", "heat3d", (512, 512, 512), 10,
+     "float32", "stream4_shard"),
+    ("heat3d_512_f32_stream4_mesh8x8", "heat3d", (512, 512, 512), 10,
+     "float32", "stream4_mesh8x8"),
+    ("wave3d_512_f32_stream4_shard", "wave3d", (512, 512, 512), 8,
+     "float32", "stream4_shard"),
+    ("wave3d_512_f32_stream4_mesh8x8", "wave3d", (512, 512, 512), 8,
+     "float32", "stream4_mesh8x8"),
+    # the bf16 k=4 story on the balanced mesh (stream is the only k=4
+    # bf16 temporal-blocking path; the 2-axis tiled kernels need k=8)
+    ("wave3d_512_bf16_stream4_mesh8x8", "wave3d", (512, 512, 512), 8,
+     "bfloat16", "stream4_mesh8x8"),
 ]
 
 # Tier-D labels: new large Mosaic compiles.  A hang here is plausibly a
@@ -495,7 +563,9 @@ _RISKY = frozenset(
 # gate, new kernel variant).  Cached untileable declines from an older
 # builder are retried instead of skipped — tileability is a property of the
 # CODE, not the config (round-3 advisor finding).
-BUILDER_REV = 6
+# rev 7: the 2-axis streaming kernel (build_stream_2axis_call) — forced
+# stream on y-sharded meshes went from None to buildable.
+BUILDER_REV = 7
 
 
 def _skip_cached(cached):

@@ -87,6 +87,7 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
 
     n_dev = math.prod(mesh_shape)
     step_unit = 1
+    kernel_kind = None  # which slab-operand kernel carried the rung
     if n_dev > 1:
         mesh = make_mesh(mesh_shape)
         if fuse > 1:
@@ -109,6 +110,13 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
                 # a row labeled overlap=true must not silently price the
                 # plain step (geometry declined the split)
                 return None
+            if fuse_kind == "stream" and not str(
+                    getattr(step, "_padfree_kind", "")).startswith(
+                        "stream"):
+                # a stream-labeled rung must not silently price another
+                # kernel class
+                return None
+            kernel_kind = getattr(step, "_padfree_kind", None)
             step_unit = fuse
         else:
             step = make_sharded_step(st, mesh, global_shape, overlap=overlap)
@@ -145,7 +153,8 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
     float(jnp.sum(run(fields)[0]))  # compile + warm
     t = _time_run(run, fields, reps)
     cells = math.prod(global_shape)
-    return cells * steps * step_unit / t / 1e6, t / (steps * step_unit)
+    return (cells * steps * step_unit / t / 1e6, t / (steps * step_unit),
+            kernel_kind)
 
 
 def bench_halo_overhead(st, mesh_shape, global_shape, steps, reps=3):
@@ -220,7 +229,12 @@ def main(argv=None) -> int:
                    help="force the streaming (sliding-window manual-DMA) "
                         "kernel for --fuse rungs — A/B vs the default "
                         "zslab/windowed kernels (virtual meshes: relative "
-                        "evidence only)")
+                        "evidence only).  Composes with --mesh-axes 1|2: "
+                        "the 1-axis ladder runs the z-slab streaming "
+                        "kernel, the 2-axis ladder the round-8 "
+                        "y-slab+corner splice variant — run both for the "
+                        "kind x mesh A/B pair; rungs that would price a "
+                        "different kernel class are skipped")
     p.add_argument("--fuse", type=int, default=0,
                    help="temporal blocking: k fused micro-steps per "
                         "width-k exchange (weak/strong modes; meshes keep "
@@ -301,7 +315,7 @@ def main(argv=None) -> int:
             print(f"[scaling] skip {mesh_shape}: untileable fused "
                   f"k={a.fuse}", file=sys.stderr)
             continue
-        mcells, per_step = got
+        mcells, per_step, kernel_kind = got
         per_dev = mcells / n_dev
         if base is None:
             base = per_dev if a.mode == "weak" else mcells
@@ -312,6 +326,7 @@ def main(argv=None) -> int:
             "mode": a.mode, "stencil": a.stencil,
             "overlap": a.overlap, "fuse": a.fuse,
             "fuse_kind": a.fuse_kind,
+            "kernel_kind": kernel_kind,
             "mesh_axes": a.mesh_axes,
             "mesh": list(mesh_shape), "grid": list(global_shape),
             "mcells_per_s": round(mcells, 1),

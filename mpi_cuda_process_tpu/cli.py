@@ -128,7 +128,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "meshes — exchanged slabs + corner pieces as "
                         "operands); stream = sliding-window manual-DMA "
                         "pipeline (every plane read once per pass; bf16 "
-                        "works at k=4; z-only meshes); auto = the "
+                        "works at k=4; under --mesh, any z/y mesh — "
+                        "2-axis meshes splice y-slab + corner operands "
+                        "into the sliding window); auto = the "
                         "measured default (padfree above the HBM "
                         "threshold, else tiled)")
     p.add_argument("--mem-check", default="error",
@@ -419,8 +421,8 @@ def build(cfg: RunConfig):
             raise ValueError(
                 "--fuse-kind selects the 3D kernel variant; 2D grids use "
                 "the whole-grid VMEM kernel, and sharded runs support "
-                "'stream' (z-only meshes) and 'padfree' (z-only and "
-                "2-axis z/y meshes — the slab-operand kernels); the "
+                "'stream' and 'padfree' on any z-only or 2-axis z/y "
+                "mesh (the slab-operand kernels); the "
                 "exchange-composed tiled kernels are 'auto'")
         if use_mesh:
             # k fused steps per width-k*halo exchange (the 4096^3-class
@@ -445,7 +447,8 @@ def build(cfg: RunConfig):
                     + (f" --fuse-kind {kind}" if kind else "")
                     + f" unsupported for {st.name} on {cfg.grid}: needs a "
                     f"fused kernel, an unsharded lane axis"
-                    + (", a z-only mesh, guard-frame BCs"
+                    + (", guard-frame BCs, local z >= 3 chunks of >= "
+                       "2*k*halo planes (any z/y mesh)"
                        if kind == "stream" else "")
                     + (", a slab-operand kernel that tiles the local "
                        "block (no padded fallback under a forced kind)"

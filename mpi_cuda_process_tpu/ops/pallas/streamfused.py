@@ -39,6 +39,17 @@ edge, and wall-side cells are re-pinned by the frame mask each micro-step
 (``_window_frame``).  Equivalence vs k plain steps is asserted by
 tests/test_streamfused.py in interpret mode for every family.
 
+Sharded variants complete the kind x mesh matrix: z-only meshes hand the
+exchanged z slabs to the kernel as operands
+(``build_stream_sharded_call``); meshes that shard y additionally take
+the y slabs and the four two-pass-composed corner pieces
+(``build_stream_2axis_call`` — edge y-strips splice slab COLUMNS into
+the sliding window in place of the unsharded clamp, corners substitute
+for the slab's z overhang at z-edge chunks), so the balanced
+surface-to-volume decompositions (8x8x1 on 64 chips: ~8x fewer face
+bytes than the z-ring) run the same lowest-traffic kernel class.
+Equivalence on 2-axis meshes: tests/test_twoaxis_stream.py.
+
 Reference anchor: this replaces the role of the reference's per-step
 middle/border kernel pair (kernel.cu:209/221) the same way fused.py does —
 k whole time steps per HBM round-trip — with the DMA schedule written by
@@ -89,7 +100,8 @@ _BZ_LADDER = (32, 16, 8)
 
 
 def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
-                 gshape, parity, origin_z, ins, outs, slabs):
+                 gshape, parity, origin_z, ins, outs, slabs,
+                 origin_y=0, yslabs=None, corners=None):
     """One (y, x) strip: slide the z window down the local block, k
     micro-steps per chunk.
 
@@ -102,6 +114,22 @@ def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
     substitute slab planes for the clamped overhang, so the window sees
     genuine neighbor values).
 
+    ``yslabs``/``corners`` (2-axis sharded kernel, requires ``slabs``):
+    per field a pair of (Lz, wm_a, X) y-slab refs — the exchanged
+    neighbor columns, caller-aligned to the sublane-rounded margin
+    ``wm_a`` (genuine data in the window-adjacent wm columns, edge-
+    replicated filler in the rest, which temporal validity excludes) —
+    and the four (wm, wm_a, X) corner refs (ll, lh, hl, hh in (z-side,
+    y-side) order, same alignment).  Edge y-strips then SPLICE slab
+    columns into the sliding window in place of the unsharded clamp:
+    the y slab rides its own z-chunk VMEM ring (same DMA schedule as
+    the core), z-edge chunks of edge strips substitute corner planes
+    for the y-slab's clamped overhang, and the spliced window's origin/
+    store offsets become strip-uniform (``wm_a``).  With one y strip
+    (by == Y) both splices apply statically; multi-strip grids select
+    per edge on the traced strip id, exactly like the tiled 2-axis
+    kernels' wall selects.
+
     ``bx`` is None for whole-lane strips (the x axis never sliced — the
     original kernel, byte-identical) or a lane-tile multiple: windows
     then carry a ``_XSHELL``-lane x shell, clamped at the (always-global)
@@ -113,9 +141,16 @@ def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
     Lz, Y, X = lshape
     nc = Lz // bz
     wz = bz + 2 * wm
-    wy = by + 2 * wm_a
+    two_axis = yslabs is not None
+    ny = Y // by
+    one_strip = two_axis and ny == 1
+    # wyc: the CORE window's column extent (what the ring DMAs carry);
+    # wy: the assembled window's extent (wyc + both slab flanks when the
+    # single strip spans the whole local y extent).
+    wyc = Y if one_strip else by + 2 * wm_a
+    wy = Y + 2 * wm_a if one_strip else wyc
     yj = pl.program_id(0)
-    ylo = jnp.clip(yj * by - wm_a, 0, Y - wy)
+    ylo = 0 if one_strip else jnp.clip(yj * by - wm_a, 0, Y - wyc)
     if bx is None:
         wx, xlo, x_idx = X, 0, ()
         store_x, out_x = 0, ()
@@ -126,34 +161,63 @@ def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
         x_idx = (pl.ds(xlo, wx),)
         store_x, out_x = xj * bx - xlo, (pl.ds(xj * bx, bx),)
 
-    def body(scratch, sems, slab_mem=None, slab_sems=None):
-        def dma(f, chunk):
-            slot = jax.lax.rem(chunk, _NSLOTS) if _traced(chunk) \
+    def body(scratch, sems, slab_mem=None, slab_sems=None, yring=None,
+             ysems=None, corner_mem=None, corner_sems=None):
+        def _slot(chunk):
+            return jax.lax.rem(chunk, _NSLOTS) if _traced(chunk) \
                 else chunk % _NSLOTS
+
+        def dma(f, chunk):
             return pltpu.make_async_copy(
-                ins[f].at[(pl.ds(chunk * bz, bz), pl.ds(ylo, wy))
+                ins[f].at[(pl.ds(chunk * bz, bz), pl.ds(ylo, wyc))
                           + x_idx],
-                scratch.at[f, pl.ds(slot * bz, bz)],
-                sems.at[f, slot])
+                scratch.at[f, pl.ds(_slot(chunk) * bz, bz)],
+                sems.at[f, _slot(chunk)])
 
         def slab_dma(f, side):
             return pltpu.make_async_copy(
-                slabs[f][side].at[(slice(None), pl.ds(ylo, wy)) + x_idx],
+                slabs[f][side].at[(slice(None), pl.ds(ylo, wyc)) + x_idx],
                 slab_mem.at[f, side],
                 slab_sems.at[f, side])
+
+        def ydma(f, side, chunk):
+            # z-chunks of the y slab ride the SAME ring schedule as the
+            # core: edge strips need the slab columns of exactly the
+            # window's z span
+            return pltpu.make_async_copy(
+                yslabs[f][side].at[(pl.ds(chunk * bz, bz), slice(None))
+                                   + x_idx],
+                yring.at[f, side, pl.ds(_slot(chunk) * bz, bz)],
+                ysems.at[f, side, _slot(chunk)])
+
+        def corner_dma(f, i):
+            return pltpu.make_async_copy(
+                corners[f][i].at[(slice(None), slice(None)) + x_idx],
+                corner_mem.at[f, i],
+                corner_sems.at[f, i])
 
         def start_all(chunk):
             for f in range(nfields):
                 dma(f, chunk).start()
+                if two_axis:
+                    for side in (0, 1):
+                        ydma(f, side, chunk).start()
 
         def wait_all(chunk):
             for f in range(nfields):
                 dma(f, chunk).wait()
+                if two_axis:
+                    for side in (0, 1):
+                        ydma(f, side, chunk).wait()
 
         if slabs is not None:
             for f in range(nfields):
                 for side in (0, 1):
                     slab_dma(f, side).start()
+        if two_axis:
+            for f in range(nfields):
+                for i in range(4):
+                    corner_dma(f, i).start()
         start_all(0)
         start_all(1)  # nc >= 3 by the builder's gate
         wait_all(0)
@@ -161,6 +225,10 @@ def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
             for f in range(nfields):
                 for side in (0, 1):
                     slab_dma(f, side).wait()
+        if two_axis:
+            for f in range(nfields):
+                for i in range(4):
+                    corner_dma(f, i).wait()
 
         def process(c, is_lo, is_hi):
             """One chunk.  ``c`` is a Python int for the peeled edge
@@ -177,19 +245,19 @@ def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
             if not is_hi:
                 wait_all(c + 1)
 
-            # Extract the window: 3 consecutive ring chunks concatenated,
+            # Extract a window: 3 consecutive ring chunks concatenated,
             # then sliced at the window origin — which is STATIC relative
             # to the concat base in every case (interior: bz - wm).
+            off = zlo - base * bz if not _traced(base) else bz - wm
+
+            def extract(read_chunk):
+                parts = [read_chunk(base + i) for i in range(3)]
+                return jnp.concatenate(parts, axis=0)[off:off + wz]
+
             fields = []
             for f in range(nfields):
-                parts = []
-                for i in range(3):
-                    ci = base + i
-                    slot = (jax.lax.rem(ci, _NSLOTS) if _traced(ci)
-                            else ci % _NSLOTS)
-                    parts.append(scratch[f, pl.ds(slot * bz, bz)])
-                off = zlo - base * bz if not _traced(base) else bz - wm
-                win = jnp.concatenate(parts, axis=0)[off:off + wz]
+                win = extract(
+                    lambda ci, f=f: scratch[f, pl.ds(_slot(ci) * bz, bz)])
                 if slabs is not None and is_lo:
                     # the true window overhangs the block by wm planes:
                     # splice the exchanged slab in place of the clamped
@@ -199,6 +267,39 @@ def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
                 elif slabs is not None and is_hi:
                     win = jnp.concatenate(
                         [win[wm:], slab_mem[f, 1]], axis=0)
+                if two_axis:
+                    # the y flanks: slab columns of the same z span,
+                    # themselves z-spliced with CORNER planes at the z
+                    # edges (the two-pass-composed diagonal data)
+                    ywins = []
+                    for side in (0, 1):
+                        yw = extract(
+                            lambda ci, f=f, side=side:
+                            yring[f, side, pl.ds(_slot(ci) * bz, bz)])
+                        if is_lo:
+                            yw = jnp.concatenate(
+                                [corner_mem[f, side], yw[:wz - wm]],
+                                axis=0)
+                        elif is_hi:
+                            yw = jnp.concatenate(
+                                [yw[wm:], corner_mem[f, 2 + side]],
+                                axis=0)
+                        ywins.append(yw)
+                    if one_strip:
+                        win = jnp.concatenate(
+                            [ywins[0], win, ywins[1]], axis=1)
+                    else:
+                        # edge strips: replace the clamp-shifted columns
+                        # by the slab flank; interior strips keep the
+                        # plain window (ylo never clipped: by >= wm_a,
+                        # gated).  Same-shape selects on the strip id.
+                        w_lo = jnp.concatenate(
+                            [ywins[0], win[:, :wyc - wm_a]], axis=1)
+                        w_hi = jnp.concatenate(
+                            [win[:, wm_a:], ywins[1]], axis=1)
+                        win = jnp.where(
+                            yj == 0, w_lo,
+                            jnp.where(yj == ny - 1, w_hi, win))
                 fields.append(win)
             fields = tuple(fields)
 
@@ -221,14 +322,23 @@ def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
             else:
                 z0 = origin_z + zlo
                 store_z = c * bz - zlo if not _traced(c) else wm
-            frame, extra = _window_frame((wz, wy, wx), z0, ylo, gshape,
+            if two_axis:
+                # spliced windows start at the strip core minus wm_a on
+                # EVERY strip (edges included) — origin and store offset
+                # are strip-uniform
+                y0 = origin_y + yj * by - wm_a
+                store_y = wm_a
+            else:
+                y0 = origin_y + ylo
+                store_y = yj * by - ylo
+            frame, extra = _window_frame((wz, wy, wx), z0, y0, gshape,
                                          halo, False, parity, x0=xlo)
             fields = _run_micros(micro, fields, frame, extra, k)
             for f in range(nfields):
                 outs[f][(pl.ds(c * bz, bz), pl.ds(yj * by, by))
                         + out_x] = (
                     jax.lax.dynamic_slice(
-                        fields[f], (store_z, yj * by - ylo, store_x),
+                        fields[f], (store_z, store_y, store_x),
                         (bz, by, bx if bx is not None else X)))
 
         process(0, True, False)
@@ -237,13 +347,20 @@ def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
         process(nc - 1, False, True)
 
     kwargs = dict(
-        scratch=pltpu.VMEM((nfields, _NSLOTS * bz, wy, wx), ins[0].dtype),
+        scratch=pltpu.VMEM((nfields, _NSLOTS * bz, wyc, wx), ins[0].dtype),
         sems=pltpu.SemaphoreType.DMA((nfields, _NSLOTS)),
     )
     if slabs is not None:
-        kwargs["slab_mem"] = pltpu.VMEM((nfields, 2, wm, wy, wx),
+        kwargs["slab_mem"] = pltpu.VMEM((nfields, 2, wm, wyc, wx),
                                         ins[0].dtype)
         kwargs["slab_sems"] = pltpu.SemaphoreType.DMA((nfields, 2))
+    if two_axis:
+        kwargs["yring"] = pltpu.VMEM(
+            (nfields, 2, _NSLOTS * bz, wm_a, wx), ins[0].dtype)
+        kwargs["ysems"] = pltpu.SemaphoreType.DMA((nfields, 2, _NSLOTS))
+        kwargs["corner_mem"] = pltpu.VMEM(
+            (nfields, 4, wm, wm_a, wx), ins[0].dtype)
+        kwargs["corner_sems"] = pltpu.SemaphoreType.DMA((nfields, 4))
     pl.run_scoped(body, **kwargs)
 
 
@@ -271,13 +388,41 @@ def _stream_sharded_kernel(micro, nfields, k, halo, wm, wm_a, bz, by, bx,
                  gshape, parity, origins[0], ins, outs, slabs)
 
 
-def _pick_strip(Z, Y, X, wm, wm_a, itemsize, nfields, sharded=False):
+def _stream_2axis_kernel(micro, nfields, k, halo, wm, wm_a, bz, by, bx,
+                         lshape, gshape, parity, *refs):
+    """2-axis sharded wrapper: ``refs`` = origins (SMEM int32 (2,)), then
+    per field [core, zslab_lo, zslab_hi, yslab_lo, yslab_hi, c_ll, c_lh,
+    c_hl, c_hh] HBM refs (y slabs/corners pre-aligned to ``wm_a``
+    columns), then nfields outputs."""
+    origins, refs = refs[0], refs[1:]
+    per = 9
+    ins = [refs[per * f] for f in range(nfields)]
+    slabs = [(refs[per * f + 1], refs[per * f + 2])
+             for f in range(nfields)]
+    yslabs = [(refs[per * f + 3], refs[per * f + 4])
+              for f in range(nfields)]
+    corners = [tuple(refs[per * f + 5:per * f + 9])
+               for f in range(nfields)]
+    outs = refs[per * nfields:]
+    _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
+                 gshape, parity, origins[0], ins, outs, slabs,
+                 origin_y=origins[1], yslabs=yslabs, corners=corners)
+
+
+def _pick_strip(Z, Y, X, wm, wm_a, itemsize, nfields, sharded=False,
+                two_axis=False):
     """Choose (bz, by, bx): Z/Y/X divisors meeting the sliding-window
     gates and the VMEM budget.  ``bx`` is None for whole-lane strips
     (preferred: no x amplification) or a lane-tile multiple when whole
     rows exceed VMEM (two-field wave at X=4096 — config 5).  Score:
     least total read amplification, then largest z chunk (fewer ring
-    warm-ups and sem ops per pass)."""
+    warm-ups and sem ops per pass).
+
+    ``two_axis`` (y-sharded local blocks): ``by == Y`` becomes a valid
+    single-strip candidate (both slab flanks spliced statically), and
+    multi-strip candidates additionally require ``by >= wm_a`` so the
+    interior strips' windows never clamp-shift (the spliced window's
+    origin/store offsets are strip-uniform)."""
     budget_item = max(itemsize, 4)  # bf16 budgeted at the f32 envelope
     # x-windowed strips clamp their 128-lane shells at the global x walls,
     # which is only sound while the window margin fits inside one shell
@@ -290,21 +435,25 @@ def _pick_strip(Z, Y, X, wm, wm_a, itemsize, nfields, sharded=False):
     x_options = [None] + ([
         c for c in (2048, 1024, 512, 256)
         if X % c == 0 and c + 2 * _XSHELL <= X] if wm <= _XSHELL else [])
+    by_options = (128, 64, 32, 16, 8)
+    if two_axis and Y not in by_options:
+        by_options = (Y,) + by_options  # the single-strip candidate
     best = None
     for bz in _BZ_LADDER:
         if Z % bz or 2 * wm > bz or Z // bz < 3:
             continue
-        for by in (128, 64, 32, 16, 8):
+        for by in by_options:
             if Y % by or by % _sublane(itemsize):
                 continue
-            wy = by + 2 * wm_a
-            if wy > Y:
+            if not _by_valid(Y, by, wm_a, two_axis):
                 continue
+            wy = (Y if two_axis and by == Y else by) + 2 * wm_a
             for bx in x_options:
                 wx = X if bx is None else bx + 2 * _XSHELL
                 x_amp = 1.0 if bx is None else wx / bx
                 live = _strip_live_bytes(bz, by, bx, X, wm, wm_a,
-                                         budget_item, nfields, sharded)
+                                         budget_item, nfields, sharded,
+                                         two_axis=two_axis, Y=Y)
                 if live > _VMEM_LIMIT:
                     continue
                 score = (-(wy / by) * x_amp, bx is None, bz, by)
@@ -313,24 +462,50 @@ def _pick_strip(Z, Y, X, wm, wm_a, itemsize, nfields, sharded=False):
     return best[1] if best else None
 
 
+def _by_valid(Y, by, wm_a, two_axis):
+    """Single definition of the y-strip gate (picker + explicit tiles).
+
+    Unsharded-y strips clamp at the walls, so the window must fit the
+    extent (``by + 2*wm_a <= Y``).  Two-axis strips splice slab flanks
+    instead: ``by == Y`` is the static single-strip case, and
+    multi-strip grids keep the window-fits gate PLUS ``by >= wm_a`` so
+    interior strips never clamp-shift (the splice assumes strip-uniform
+    window origins)."""
+    if two_axis and by == Y:
+        return True
+    if by + 2 * wm_a > Y:
+        return False
+    return not two_axis or by >= wm_a
+
+
 def _strip_live_bytes(bz, by, bx, X, wm, wm_a, budget_item, nfields,
-                      sharded):
+                      sharded, two_axis=False, Y=None):
     """Scoped-VMEM live-set model for one strip program — the single
     definition used by both the picker and explicit-tile validation (an
     unvalidated explicit tile was the round-4 silently-wrong-geometry
     lesson: a 'fits' must never admit a config the kernel can't host)."""
     wz = bz + 2 * wm
-    wy = by + 2 * wm_a
+    one_strip = two_axis and Y is not None and by == Y
+    wyc = Y if one_strip else by + 2 * wm_a      # ring/core extent
+    wy = Y + 2 * wm_a if one_strip else wyc      # assembled window
     wx = X if bx is None else bx + 2 * _XSHELL
-    strip = wy * _lane_round(wx) * budget_item
+    strip = wyc * _lane_round(wx) * budget_item
+    win = wy * _lane_round(wx) * budget_item
     # ring + 3-chunk concat + window with ~3 live micro temporaries +
     # the store slice
     live = (_NSLOTS * bz * strip + 3 * bz * strip
-            + 4 * wz * strip + bz * strip) * nfields
+            + 4 * wz * win + bz * win) * nfields
     if sharded:
         # the slab ring (both sides, every field) + the edge chunks'
         # splice-concat temporary
-        live += (2 * 2 * wm * strip + wz * strip) * nfields
+        live += (2 * 2 * wm * strip + wz * win) * nfields
+    if two_axis:
+        # the y-slab rings + their concat temporaries + the corner
+        # planes + the two same-shape select branches of the y splice
+        ystrip = wm_a * _lane_round(wx) * budget_item
+        live += (2 * _NSLOTS * bz * ystrip + 2 * 3 * bz * ystrip
+                 + 2 * wz * ystrip + 4 * wm * ystrip
+                 + 2 * wz * win) * nfields
     return live
 
 
@@ -338,7 +513,8 @@ def stream_supported(stencil: Stencil) -> bool:
     return stencil.name in _MICRO and stencil.ndim == 3
 
 
-def _stream_gates(stencil, Lz, Y, X, k, tiles, sharded=False):
+def _stream_gates(stencil, Lz, Y, X, k, tiles, sharded=False,
+                  two_axis=False):
     """Shared builder gates; returns
     ``(micro_factory, halo, nfields, wm, wm_a, bz, by, bx)`` or None —
     ``bx`` is None for whole-lane strips, else the x-window extent."""
@@ -349,7 +525,7 @@ def _stream_gates(stencil, Lz, Y, X, k, tiles, sharded=False):
     wm_a = -(-wm // sub) * sub  # margin rounded to a DMA-alignable offset
     if tiles is None:
         tiles = _pick_strip(Lz, Y, X, wm, wm_a, itemsize, nfields,
-                            sharded=sharded)
+                            sharded=sharded, two_axis=two_axis)
         if tiles is None:
             return None
     if len(tiles) == 2:
@@ -358,14 +534,15 @@ def _stream_gates(stencil, Lz, Y, X, k, tiles, sharded=False):
     else:
         bz, by, bx = tiles
     if (Lz % bz or Y % by or 2 * wm > bz or Lz // bz < 3
-            or by % sub or by + 2 * wm_a > Y):
+            or by % sub or not _by_valid(Y, by, wm_a, two_axis)):
         return None
     if bx is not None and (X % bx or bx % _XSHELL
                            or bx + 2 * _XSHELL > X or wm > _XSHELL):
         return None
     # explicit tiles go through the SAME live-set gate as the picker
     if _strip_live_bytes(bz, by, bx, X, wm, wm_a, max(itemsize, 4),
-                         nfields, sharded) > _VMEM_LIMIT:
+                         nfields, sharded, two_axis=two_axis,
+                         Y=Y) > _VMEM_LIMIT:
         return None
     return micro_factory, halo, nfields, wm, wm_a, bz, by, bx
 
@@ -429,6 +606,102 @@ def build_stream_sharded_call(
             vmem_limit_bytes=_VMEM_LIMIT_BYTES,
             dimension_semantics=("arbitrary",) * len(grid)),
     )
+    return call, wm, nfields
+
+
+def build_stream_2axis_call(
+    stencil: Stencil,
+    local_shape: Tuple[int, int, int],
+    global_shape: Tuple[int, int, int],
+    k: int,
+    tiles: Optional[Tuple[int, ...]] = None,  # (bz, by[, bx])
+    interpret: Optional[bool] = None,
+    periodic: bool = False,
+):
+    """Streaming kernel over a (z, y)- or y-decomposed LOCAL block — the
+    2-axis generalization of ``build_stream_sharded_call``, closing the
+    last kind x mesh gap (the balanced surface-to-volume meshes could
+    not use the lowest-traffic kernel class).
+
+    The call takes origins (int32 (2,): this shard's global z AND y
+    block offsets), then per field ``[core, zslab_lo, zslab_hi,
+    yslab_lo, yslab_hi, c_ll, c_lh, c_hl, c_hh]`` — the operand set of
+    ``halo.exchange_slabs_2axis`` at their NATURAL widths (z slabs
+    (m, Ly, X), y slabs (Lz, m, X), corners (m, m, X)); the returned
+    call aligns the y-facing operands to the sublane-rounded margin
+    ``wm_a`` internally (edge-replicated filler on the window-far side —
+    the streaming analogue of the tiled kernels' 2m duplication; the
+    filler lands on don't-care cells temporal validity excludes).
+    Returns ``(call, margin, nfields)`` or None.
+
+    Edge y-strips splice the slab columns into the sliding window in
+    place of the unsharded clamp (the y slab rides its own z-chunk VMEM
+    ring; corner planes substitute for the slab's z overhang at z-edge
+    chunks), so interior shards see genuine neighbor values on BOTH
+    wall axes; at global walls the slabs hold the bc fill and the frame
+    re-pins.  The x-windowed strip variant is preserved (3-extent
+    tiles / the picker's x ladder), which is what keeps two-field wave
+    tileable at 4096 lanes on the balanced meshes.  Guard-frame only
+    (periodic declines; the sharded caller falls back).  An unsharded
+    axis degrades through bc-fill dummy slabs from the same exchange
+    helper, so one call serves (z, y)- and y-only-sharded meshes.
+    """
+    if periodic or not stream_supported(stencil):
+        return None
+    if interpret is None:
+        interpret = _interpret_default()
+    Lz, Ly, X = (int(s) for s in local_shape)
+    gshape = tuple(int(s) for s in global_shape)
+    gates = _stream_gates(stencil, Lz, Ly, X, k, tiles, sharded=True,
+                          two_axis=True)
+    if gates is None:
+        return None
+    micro_factory, halo, nfields, wm, wm_a, bz, by, bx = gates
+    micro = micro_factory(stencil, interpret)
+    parity = bool(stencil.phases)
+
+    def kernel(*refs):
+        _stream_2axis_kernel(micro, nfields, k, halo, wm, wm_a, bz, by,
+                             bx, (Lz, Ly, X), gshape, parity, *refs)
+
+    grid = (Ly // by,) if bx is None else (Ly // by, X // bx)
+    pallas = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [pl.BlockSpec(memory_space=pl.ANY)] * (9 * nfields),
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * nfields,
+        out_shape=[jax.ShapeDtypeStruct((Lz, Ly, X), stencil.dtype)
+                   for _ in range(nfields)],
+        interpret=interpret,
+        compiler_params=None if interpret else compiler_params(
+            vmem_limit_bytes=_VMEM_LIMIT_BYTES,
+            dimension_semantics=("arbitrary",) * len(grid)),
+    )
+    pad = wm_a - wm
+
+    def _align(a, lo_side):
+        # pad the m-wide y extent up to the DMA-alignable wm_a: the
+        # filler goes on the side AWAY from the window core (lo-side
+        # slabs are read as the window's leading columns, so genuine
+        # data must sit in the LAST wm columns, and vice versa)
+        if pad == 0:
+            return a
+        cfg = [(0, 0)] * 3
+        cfg[1] = (pad, 0) if lo_side else (0, pad)
+        return jnp.pad(a, cfg, mode="edge")
+
+    def call(origins, *args):
+        ops = []
+        for f in range(nfields):
+            core, zlo, zhi, ylo, yhi, c_ll, c_lh, c_hl, c_hh = \
+                args[9 * f:9 * f + 9]
+            ops += [core, zlo, zhi,
+                    _align(ylo, True), _align(yhi, False),
+                    _align(c_ll, True), _align(c_lh, False),
+                    _align(c_hl, True), _align(c_hh, False)]
+        return pallas(origins, *ops)
+
     return call, wm, nfields
 
 

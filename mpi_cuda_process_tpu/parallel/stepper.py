@@ -320,10 +320,14 @@ def make_sharded_fused_step(
     shape — a forced kind must never silently run the padded kernel).
 
     ``kind="stream"`` forces the sliding-window streaming kernel
-    (ops/pallas/streamfused.py, z-only meshes, guard-frame): slab
+    (ops/pallas/streamfused.py, any z/y mesh, guard-frame): slab
     operands like the z-slab kernels, but every core plane is DMA'd once
     per pass — the projected config-5 winner, pending real-chip
-    measurement (auto policy unchanged until then).
+    measurement (auto policy unchanged until then).  Meshes that shard
+    y take the 2-axis variant (``build_stream_2axis_call``: y slabs +
+    the four corner pieces spliced into the sliding window), so the
+    balanced surface-to-volume decompositions use the same kernel
+    class; z-only meshes keep the measured z-slab variant.
 
     ``overlap=True`` selects the communication-overlapped split — the
     temporal-blocked analogue of ``make_sharded_step(overlap=True)`` (the
@@ -370,13 +374,21 @@ def make_sharded_fused_step(
 
     z_only = counts[1] == 1
     if kind == "stream":
-        # forced streaming (sliding-window manual DMA): z-only meshes,
-        # guard-frame — the measured-policy candidate for config 5 (the
-        # wide-X kernel's 4.5x read amplification vs streaming's ~1.13x)
+        # forced streaming (sliding-window manual DMA), guard-frame —
+        # the measured-policy candidate for config 5 (the wide-X
+        # kernel's 4.5x read amplification vs streaming's ~1.13x).
+        # z-only meshes take the measured z-slab variant; meshes that
+        # shard y take the 2-axis variant (y-slab + corner operands
+        # spliced into the sliding window), so the balanced
+        # surface-to-volume decompositions no longer forfeit the
+        # lowest-traffic kernel class.
         from ..ops.pallas.streamfused import build_stream_sharded_call
 
         if not z_only:
-            return None
+            return _make_yzslab_padfree_step(
+                stencil, mesh, global_shape, local_shape, axis_names,
+                counts, k, interpret, periodic, overlap=overlap,
+                stream=True)
         return _make_zslab_padfree_step(
             stencil, mesh, global_shape, local_shape, axis_names, counts,
             k, build_stream_sharded_call, (1, 1), interpret, periodic,
@@ -677,7 +689,7 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
 
 def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
                               axis_names, counts, k, interpret, periodic,
-                              overlap=False):
+                              overlap=False, stream=False):
     """shard_map wrapper for the 2-AXIS pad-free fused kernels
     (y-sharded and y+z-sharded meshes): width-m slab exchange on both
     wall axes plus the four corner pieces by two-pass composition
@@ -687,6 +699,15 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
     4x4x4).  Falls back whole-row -> wide-X; an unsharded axis (z on a
     (1, ny, 1) mesh) receives local bc/wrap dummy slabs from the same
     exchange helper, so one wrapper serves every non-z-only mesh shape.
+
+    ``stream=True`` routes the SAME operand set through the 2-axis
+    sliding-window streaming kernel
+    (``streamfused.build_stream_2axis_call``) instead of the tiled
+    pad-free kernels — slabs and corners at their natural widths, the
+    call aligns them internally; no wide-X fallback chain exists (the
+    streaming builder windows the lane axis itself when whole-lane
+    strips exceed VMEM), and a decline returns None (a forced kind must
+    never silently run a different kernel class).
 
     ``overlap=True``: the exchanged slabs/corners feed ONLY the
     width-``2m`` boundary-shell calls (one lo+hi pair per sharded axis,
@@ -705,18 +726,27 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
 
     m = k * _halo_per_micro(stencil)
     gshape = tuple(int(g) for g in global_shape)
-    kind_name = "yzslab"
-    built = build_yzslab_padfree_call(stencil, local_shape, gshape, k,
-                                      interpret=interpret,
-                                      periodic=periodic)
     xrep = 1
-    if built is None:
-        # whole-row windows exceed VMEM (wide X x multi-field): window
-        # the lane axis too — each x-position repeats the 25-view group
-        built = build_yzslab_xwin_call(stencil, local_shape, gshape, k,
-                                       interpret=interpret,
-                                       periodic=periodic)
-        kind_name, xrep = "yzslab_xwin", 3
+    if stream:
+        from ..ops.pallas.streamfused import build_stream_2axis_call
+
+        kind_name = "stream_yz"
+        built = build_stream_2axis_call(stencil, local_shape, gshape, k,
+                                        interpret=interpret,
+                                        periodic=periodic)
+    else:
+        kind_name = "yzslab"
+        built = build_yzslab_padfree_call(stencil, local_shape, gshape, k,
+                                          interpret=interpret,
+                                          periodic=periodic)
+        if built is None:
+            # whole-row windows exceed VMEM (wide X x multi-field):
+            # window the lane axis too — each x-position repeats the
+            # 25-view group
+            built = build_yzslab_xwin_call(stencil, local_shape, gshape,
+                                           k, interpret=interpret,
+                                           periodic=periodic)
+            kind_name, xrep = "yzslab_xwin", 3
     if built is None:
         return None
     call, m_built, nfields = built
@@ -757,9 +787,14 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
     def _kernel_args(fields, ex):
         args = []
         for f, ((zlo, zhi), (ylo, yhi), cs) in zip(fields, ex):
-            group = ([f] * 9 + [zlo] * 3 + [zhi] * 3
-                     + [_dup_y(ylo)] * 3 + [_dup_y(yhi)] * 3
-                     + [_dup_y(c) for c in cs])
+            if stream:
+                # natural-width operands: the streaming call aligns the
+                # y-facing slabs/corners to wm_a itself
+                group = [f, zlo, zhi, ylo, yhi] + list(cs)
+            else:
+                group = ([f] * 9 + [zlo] * 3 + [zhi] * 3
+                         + [_dup_y(ylo)] * 3 + [_dup_y(yhi)] * 3
+                         + [_dup_y(c) for c in cs])
             args += group * xrep
         return args
 
@@ -1023,8 +1058,9 @@ def make_sharded_temporal_step(
     (cli --fuse --mesh, benchmarks/scaling.py --fuse) that should not
     care which kernel shape implements the k-steps-per-exchange strategy.
     Returns None when the (stencil, mesh, shape, k) combination is
-    unsupported by the applicable builder.  ``kind="stream"`` (3D,
-    z-only meshes) forces the sliding-window streaming kernel;
+    unsupported by the applicable builder.  ``kind="stream"`` (3D, any
+    z/y mesh) forces the sliding-window streaming kernel (2-axis
+    meshes take the y-slab + corner-operand variant);
     ``kind="padfree"`` (3D, any z/y mesh) forces the slab-operand
     kernels with no padded fallback.
     ``overlap=True`` selects the communication-overlapped interior/

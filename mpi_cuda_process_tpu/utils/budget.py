@@ -159,25 +159,50 @@ def estimate_run_bytes(
         # "fits" must never describe an unconstructible execution).
         # Builder construction is pure Python — no compile happens here.
         if sharded and fuse_kind == "stream":
-            # slab operands only (zslab contract); the VMEM ring is not
-            # HBM.  Probe construction so a "fits" never describes an
-            # unconstructible run (cli raises before any allocation).
-            from ..ops.pallas.streamfused import build_stream_sharded_call
+            # slab operands only (the VMEM rings are not HBM).  Probe
+            # construction so a "fits" never describes an unconstructible
+            # run (cli raises before any allocation).  z-only meshes take
+            # the zslab contract; meshes that shard y take the 2-axis
+            # contract (y slabs + corners at natural width m, plus the
+            # call's wm_a-aligned copies of the y-facing operands).
+            from ..ops.pallas.fused import _sublane
+            from ..ops.pallas.streamfused import (
+                build_stream_2axis_call,
+                build_stream_sharded_call,
+            )
 
-            ok = z_only and build_stream_sharded_call(
-                stencil, local, tuple(int(g) for g in grid), fuse,
-                interpret=True, periodic=periodic) is not None
-            slab_b = batch * 2 * m * ly * lx * itemsize * nfields
+            grid_t = tuple(int(g) for g in grid)
+            if z_only:
+                ok = lane_whole and build_stream_sharded_call(
+                    stencil, local, grid_t, fuse,
+                    interpret=True, periodic=periodic) is not None
+                slab_cells = 2 * m * ly * lx
+                what = f"slab operands only (2x{m} rows"
+            else:
+                ok = lane_whole and build_stream_2axis_call(
+                    stencil, local, grid_t, fuse,
+                    interpret=True, periodic=periodic) is not None
+                # z slabs (width m) + y slabs and corners at width m PLUS
+                # their wm_a-aligned copies (the sublane-rounded margin
+                # the streaming DMA offsets need)
+                m_a = -(-m // _sublane(itemsize)) * _sublane(itemsize)
+                slab_cells = (2 * m * ly * lx
+                              + 2 * (m + m_a) * lz * lx
+                              + 4 * m * (m + m_a) * lx)
+                what = (f"slab+corner operands only (2-axis stream, "
+                        f"width {m}, y-aligned {m_a}")
+            slab_b = batch * slab_cells * itemsize * nfields
             if overlap:
-                # dummy interior slabs + the two 4m-row shell strips live
-                # alongside the exchanged slabs during the split
+                # dummy interior slabs + the shell strips live alongside
+                # the exchanged slabs during the split
                 slab_b *= 2
             parts.append(
-                (f"sharded streaming: slab operands only (2x{m} rows"
+                (f"sharded streaming: {what}"
                  f"{', x2 overlap split' if overlap else ''})"
                  if ok else
-                 "sharded streaming: UNBUILDABLE for this shape (the run "
-                 "refuses before allocating)", slab_b if ok else 0))
+                 "sharded streaming: UNBUILDABLE for this mesh/shape "
+                 "(the run refuses before allocating)",
+                 slab_b if ok else 0))
         elif sharded and fuse_kind == "padfree":
             # forced pad-free under a mesh: no padded fallback exists
             # (make_sharded_fused_step returns None and cli raises), so

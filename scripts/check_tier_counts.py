@@ -11,6 +11,12 @@ nonzero with the fix-it text when they disagree.  Invoked by
 ``scripts/tier1.sh`` after the test run, so the gate a builder actually
 runs also checks the claim.
 
+The same line pins the MULTICHIP-DRYRUN leg count (``dryrun-legs=K``,
+round 8): each leg of ``__graft_entry__._dryrun_impl`` is marked by an
+explicit ``_leg("name")`` call, counted statically here — a new leg (or
+a silently dropped one) fails the gate until pytest.ini moves with it,
+exactly the tier-count discipline applied to the driver-visible dryrun.
+
 Counts are environment-sensitive only through optional test deps
 (tests/test_properties.py importorskips ``hypothesis``: with it
 installed the default tier collects more tests).  The committed numbers
@@ -29,13 +35,23 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _declared():
     with open(os.path.join(_REPO, "pytest.ini")) as fh:
         ini = fh.read()
-    m = re.search(r"tier-counts:\s*default=(\d+)\s+slow=(\d+)", ini)
+    m = re.search(r"tier-counts:\s*default=(\d+)\s+slow=(\d+)"
+                  r"\s+dryrun-legs=(\d+)", ini)
     if not m:
-        print("check_tier_counts: no 'tier-counts: default=N slow=M' "
-              "line in pytest.ini — add one so the guard can check it",
-              file=sys.stderr)
+        print("check_tier_counts: no 'tier-counts: default=N slow=M "
+              "dryrun-legs=K' line in pytest.ini — add one so the guard "
+              "can check it", file=sys.stderr)
         sys.exit(2)
-    return int(m.group(1)), int(m.group(2))
+    return int(m.group(1)), int(m.group(2)), int(m.group(3))
+
+
+def _dryrun_legs():
+    """Static count of the ``_leg("...")`` markers in __graft_entry__.py
+    (line-anchored so the explanatory comment above the helper never
+    counts)."""
+    with open(os.path.join(_REPO, "__graft_entry__.py")) as fh:
+        src = fh.read()
+    return len(re.findall(r'^\s*_leg\("', src, flags=re.MULTILINE))
 
 
 def _collected(extra):
@@ -55,9 +71,10 @@ def _collected(extra):
 
 
 def main():
-    want_default, want_slow = _declared()
+    want_default, want_slow, want_legs = _declared()
     got_default = _collected([])            # addopts: not slow and not tpu
     got_slow = _collected(["-m", "slow"])
+    got_legs = _dryrun_legs()
     ok = True
     for tier, want, got in (("default", want_default, got_default),
                             ("slow", want_slow, got_slow)):
@@ -66,9 +83,15 @@ def main():
             print(f"check_tier_counts: pytest.ini claims {want} {tier}-tier "
                   f"tests but the tree collects {got} — update the "
                   f"'tier-counts:' line in pytest.ini", file=sys.stderr)
+    if want_legs != got_legs:
+        ok = False
+        print(f"check_tier_counts: pytest.ini claims {want_legs} "
+              f"multichip-dryrun legs but __graft_entry__.py marks "
+              f"{got_legs} with _leg(...) — update the 'dryrun-legs=' "
+              f"value (and mark every new leg)", file=sys.stderr)
     if ok:
         print(f"check_tier_counts: ok (default={got_default}, "
-              f"slow={got_slow})")
+              f"slow={got_slow}, dryrun-legs={got_legs})")
     sys.exit(0 if ok else 1)
 
 

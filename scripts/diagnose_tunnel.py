@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Bounded, time-boxed CLIENT-SIDE diagnosis of a wedged TPU tunnel.
+
+VERDICT r5 weak #1 / next #2: through ~40 h of cumulative wedge the only
+response was a passive probe loop — nobody determined whether the wedge
+is client-side or server-side, whether a fresh process with a clean JAX
+cache behaves differently, or WHICH layer hangs.  This script converts
+docs/STATE.md's H3 ("half-healthy compile service") from a hypothesis
+into a finding (or an eliminated hypothesis) by running a ladder of
+probes, each in a FRESH subprocess with a hard timeout and its stderr —
+the tunnel client's own error channel — captured:
+
+  cpu_control      CPU-forced trivial op: distinguishes "this machine /
+                   python env is broken" from "the tunnel is broken".
+                   Must pass for any other verdict to mean anything.
+  discovery        ``import jax; jax.default_backend()`` under the
+                   default (axon sitecustomize) environment: does
+                   backend/session discovery itself hang?
+  discovery_clean  the same probe with a FRESH JAX compilation cache
+                   (JAX_COMPILATION_CACHE_DIR -> empty temp dir, the
+                   persistent-cache env knobs cleared): a divergence
+                   from ``discovery`` implicates client-side cache
+                   state, which a process restart would NOT clear.
+  execute          a trivial device op (``jnp.add(1, 1)``): the
+                   dispatch/execute layer past discovery.
+  compile          ``jax.jit`` of a tiny fresh function (a random
+                   constant baked in so no cache can serve it): the
+                   remote-compile layer — H3's suspect.
+
+Every probe is bounded (default 120 s — far above the ~66 ms healthy
+round-trip, far below the outer harness budgets), so the WORST case is
+~10 minutes, never a hang.  The ladder stops early once a layer hangs
+(running more probes against a wedged tunnel risks deepening the wedge;
+everything below the first hang is unreachable anyway).
+
+Output: a human-readable report on stderr, one JSON line on stdout, and
+``--append-state`` appends a timestamped findings section to
+docs/STATE.md so the diagnosis lands where the next session reads it.
+
+Do NOT run this concurrently with another TPU process (the 2026-07-29
+two-process wedge, docs/STATE.md infra gotchas).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_STATE = os.path.join(_REPO, "docs", "STATE.md")
+
+# (name, code, needs_clean_cache, forces_cpu)
+_PROBES = [
+    ("cpu_control",
+     "import jax; jax.config.update('jax_platforms', 'cpu'); "
+     "import jax.numpy as jnp; print('OK', int(jnp.add(1, 1)))",
+     False, True),
+    ("discovery",
+     "import jax; print('OK', jax.default_backend(), len(jax.devices()))",
+     False, False),
+    ("discovery_clean",
+     "import jax; print('OK', jax.default_backend(), len(jax.devices()))",
+     True, False),
+    ("execute",
+     "import jax, jax.numpy as jnp; "
+     "print('OK', jax.default_backend(), int(jnp.add(1, 1)))",
+     False, False),
+    ("compile",
+     # a fresh constant per invocation: no persistent cache can serve it,
+     # so this exercises the REMOTE COMPILE path every time
+     "import os, jax, jax.numpy as jnp; c = float(os.getpid() % 997); "
+     "f = jax.jit(lambda x: x * c + 1.0); "
+     "print('OK', jax.default_backend(), float(f(jnp.float32(2.0))))",
+     False, False),
+]
+
+
+def _run_probe(name, code, clean_cache, force_cpu, timeout_s):
+    env = dict(os.environ)
+    tmp = None
+    if clean_cache:
+        tmp = tempfile.mkdtemp(prefix="jax_clean_cache_")
+        env["JAX_COMPILATION_CACHE_DIR"] = tmp
+        # clear every persistent-cache knob the client might read
+        for k in list(env):
+            if "CACHE" in k and k.startswith(("JAX_", "LIBTPU_")) \
+                    and k != "JAX_COMPILATION_CACHE_DIR":
+                env.pop(k)
+    if force_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    t0 = time.monotonic()
+    rec = {"probe": name, "timeout_s": timeout_s}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True,
+            text=True, timeout=timeout_s, cwd=_REPO)
+        rec["wall_s"] = round(time.monotonic() - t0, 2)
+        rec["rc"] = proc.returncode
+        rec["ok"] = proc.returncode == 0 and "OK" in proc.stdout
+        rec["stdout"] = proc.stdout.strip()[-400:]
+        # the tunnel client's own error channel — the piece no previous
+        # round ever captured
+        rec["stderr_tail"] = proc.stderr.strip()[-1500:]
+    except subprocess.TimeoutExpired as e:
+        rec["wall_s"] = round(time.monotonic() - t0, 2)
+        rec["ok"] = False
+        rec["hang"] = True
+        rec["stderr_tail"] = ((e.stderr or b"").decode("utf-8", "replace")
+                              if isinstance(e.stderr, bytes)
+                              else (e.stderr or ""))[-1500:]
+    except Exception as e:  # noqa: BLE001 — a diagnosis must not crash
+        rec["wall_s"] = round(time.monotonic() - t0, 2)
+        rec["ok"] = False
+        rec["error"] = f"{type(e).__name__}: {e}"
+    return rec
+
+
+def _classify(results):
+    """Map the probe ladder to a layer verdict (the H1/H2/H3 language of
+    docs/STATE.md)."""
+    r = {rec["probe"]: rec for rec in results}
+
+    def hung(name):
+        return name in r and r[name].get("hang")
+
+    def ok(name):
+        return name in r and r[name].get("ok")
+
+    if not ok("cpu_control"):
+        return ("ENVIRONMENT", "the CPU control probe failed — this "
+                "machine/python env is broken independent of the tunnel; "
+                "no tunnel verdict is possible")
+    if ok("discovery") and \
+            "tpu" not in r["discovery"].get("stdout", ""):
+        return ("NO_TPU", "backend discovery succeeds but reports a "
+                "non-TPU backend — no tunnel is visible from this box; "
+                "nothing to diagnose (the CPU-probe ladder still "
+                "validates the tool end-to-end)")
+    if hung("discovery") and hung("discovery_clean"):
+        return ("SESSION_LAYER", "backend discovery hangs with AND "
+                "without a clean JAX cache — the wedge lives at the "
+                "tunnel session/discovery layer, server-side or "
+                "connection-level; a client cache purge would not help")
+    if hung("discovery") and ok("discovery_clean"):
+        return ("CLIENT_CACHE", "discovery hangs under the default cache "
+                "but succeeds with a fresh one — CLIENT-side cache state "
+                "is implicated; purge the JAX compilation cache dir")
+    if ok("discovery") and hung("execute"):
+        return ("EXECUTE_LAYER", "discovery succeeds but a trivial "
+                "device op hangs — the wedge is in dispatch/execute, "
+                "past session setup")
+    if ok("execute") and hung("compile"):
+        return ("COMPILE_LAYER", "trivial ops execute but a fresh jit "
+                "compile hangs — STATE.md H3 (half-healthy compile "
+                "service) is now a FINDING, not a hypothesis")
+    if ok("compile"):
+        return ("HEALTHY", "every layer answered within budget — the "
+                "tunnel is healthy right now (run the campaign)")
+    return ("INCONCLUSIVE", "probe pattern fits no single layer — read "
+            "the per-probe stderr tails")
+
+
+def _state_section(verdict, detail, results, started):
+    ts = datetime.datetime.fromtimestamp(started).strftime(
+        "%Y-%m-%d %H:%M")
+    lines = [
+        "",
+        f"## Tunnel wedge diagnosis ({ts}, scripts/diagnose_tunnel.py)",
+        "",
+        f"- **Verdict: {verdict}** — {detail}",
+        "- Probe ladder (fresh subprocess each, hard timeout, stderr "
+        "captured):",
+        "",
+        "| probe | result | wall s | stderr tail (last line) |",
+        "|---|---|---:|---|",
+    ]
+    for rec in results:
+        if rec.get("hang"):
+            res = "HANG"
+        elif rec.get("ok"):
+            res = "ok"
+        else:
+            res = f"fail rc={rec.get('rc', '?')}"
+        tail = (rec.get("stderr_tail") or "").strip().splitlines()
+        tail = tail[-1][:90].replace("|", "\\|") if tail else ""
+        lines.append(f"| {rec['probe']} | {res} | {rec.get('wall_s', 0)} "
+                     f"| {tail} |")
+    lines.append("")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--timeout", type=float, default=120.0,
+                   help="per-probe hard timeout in seconds (default 120; "
+                        "total worst case = n_probes x timeout)")
+    p.add_argument("--append-state", action="store_true",
+                   help="append the findings section to docs/STATE.md")
+    p.add_argument("--json-out", default=None,
+                   help="also write the full JSON record to this path")
+    a = p.parse_args(argv)
+
+    started = time.time()
+    results = []
+    for name, code, clean, cpu in _PROBES:
+        print(f"[diagnose] probe {name} (<= {a.timeout:.0f}s) ...",
+              file=sys.stderr)
+        rec = _run_probe(name, code, clean, cpu, a.timeout)
+        results.append(rec)
+        state = ("HANG" if rec.get("hang")
+                 else "ok" if rec.get("ok") else "fail")
+        print(f"[diagnose]   -> {state} in {rec.get('wall_s')}s",
+              file=sys.stderr)
+        if rec.get("hang") and name != "discovery":
+            # stop after the first hang past the discovery pair: deeper
+            # probes are unreachable, and piling processes onto a wedged
+            # tunnel is how wedges deepen
+            break
+        if name == "cpu_control" and not rec.get("ok"):
+            break
+
+    verdict, detail = _classify(results)
+    record = {"tool": "diagnose_tunnel", "started_at": started,
+              "verdict": verdict, "detail": detail, "probes": results}
+    print(json.dumps(record))
+    print(f"[diagnose] VERDICT: {verdict} — {detail}", file=sys.stderr)
+    if a.json_out:
+        with open(a.json_out, "w") as fh:
+            json.dump(record, fh, indent=1)
+    if a.append_state:
+        with open(_STATE, "a") as fh:
+            fh.write(_state_section(verdict, detail, results, started))
+        print(f"[diagnose] findings appended to {_STATE}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -180,6 +180,38 @@ def test_stale_fallback_replays_only_local_measurements(bench, tmp_path):
         bench._CACHE = old
 
 
+def test_stale_record_carries_last_real_measurement(bench, tmp_path):
+    """VERDICT r5 weak #7: the wedged-path record must distinguish
+    "never measured" from "measured N Gcells/s, tunnel currently dead".
+    Both stale paths carry a provenance-marked ``last_real_measurement``
+    pointer; the scorable ``value`` stays 0.0/stale on the honest paths
+    (VCS data is cited, never replayed as a value)."""
+    old = bench._CACHE
+    try:
+        # no local cache: value stays 0.0, but the committed campaign
+        # table's newest timestamped row is cited with an explicit
+        # not-a-local-measurement source
+        bench._CACHE = str(tmp_path / "absent.json")
+        rec = bench._stale_fallback_record()
+        assert rec["value"] == 0.0 and rec["stale"] is True
+        last = rec["last_real_measurement"]
+        assert last["value"] > 0 and last["measured_at"] > 0
+        assert "not a local measurement" in last["source"]
+        assert last["label"]  # a real campaign label, e.g. heat3d_512_...
+        json.dumps(rec)
+        # a local cache record: the pointer names the local cache
+        local = tmp_path / "local.json"
+        local.write_text(json.dumps(
+            {"metric": "m", "value": 85621.8, "backend": "tpu",
+             "measured_at": 1785358700.0, "local_run": True}))
+        bench._CACHE = str(local)
+        rec = bench._stale_fallback_record()
+        assert rec["last_real_measurement"]["source"] == "local bench cache"
+        assert rec["last_real_measurement"]["value"] == 85621.8
+    finally:
+        bench._CACHE = old
+
+
 def test_mktable_regenerates_from_campaign(capsys):
     """benchmarks/mktable.py renders the measured table from a results
     file with the LIVE auto-policy picks bolded — the mechanism that

@@ -257,6 +257,46 @@ def test_config5_stream_budget_exact_bytes():
                             fuse_kind="stream", hbm_bytes=16 * GiB)
 
 
+def test_config5_stream_two_axis_budget_exact_bytes():
+    """Round 8: config 5 on the BALANCED 8x8x1 mesh through the 2-AXIS
+    streaming kernel — the kind x mesh matrix's last cell, pinned to the
+    byte for BOTH dtypes.  HBM holds state + out + the slab/corner
+    operand set (z slabs at width m; y slabs and corners at width m plus
+    the call's wm_a-aligned copies — 8 for f32, 16 for bf16); the VMEM
+    rings are not HBM.  Both dtypes fit 16 GiB v5e HBM, so mesh shape is
+    now purely a measurement decision for the streaming kind too."""
+    for dtype, item, m_a, total_expect in (
+            ("float32", 4, 8, 14_770_870_681),
+            ("bfloat16", 2, 16, 7_535_381_708)):
+        st = make_stencil("wave3d", dtype=dtype)
+        total, parts = budget.estimate_run_bytes(
+            st, (4096,) * 3, mesh=(8, 8, 1), fuse=4, fuse_kind="stream")
+        # independent arithmetic (not the module's own constants)
+        lz, ly, lx, m, nf = 512, 512, 4096, 4, 2
+        state = 2 * lz * ly * lx * item
+        out = lz * ly * lx * item
+        slabs = (2 * m * ly * lx                # z slabs
+                 + 2 * (m + m_a) * lz * lx     # y slabs + aligned copies
+                 + 4 * m * (m + m_a) * lx      # corners + aligned copies
+                 ) * item * nf
+        assert total == int((state + out + slabs) * 1.10) == total_expect
+        assert any("2-axis stream" in label for label, _ in parts)
+        assert not any("UNBUILDABLE" in label for label, _ in parts)
+        assert not any("pad transient" in label for label, _ in parts)
+        budget.check_budget(st, (4096,) * 3, mesh=(8, 8, 1), fuse=4,
+                            fuse_kind="stream", hbm_bytes=V5E_HBM)
+
+
+def test_stream_two_axis_unbuildable_is_labeled():
+    """An unconstructible 2-axis streaming config must be labeled, never
+    a silent 'fits' (the budget module's invariant) — local z below the
+    3-chunk gate here."""
+    st = make_stencil("heat3d")
+    _, parts = budget.estimate_run_bytes(
+        st, (32, 64, 128), mesh=(2, 2, 1), fuse=4, fuse_kind="stream")
+    assert any("UNBUILDABLE" in label for label, _ in parts)
+
+
 def test_config5_wave_f32_fits_via_wide_x_kernel():
     """Two-field wave3d cannot tile the WHOLE-ROW z-slab window at X=4096
     (VMEM gate), but the wide-X variant windows the lane axis and tiles —

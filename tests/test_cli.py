@@ -377,10 +377,18 @@ def test_fuse_kind_rejects_bad_configs():
     with pytest.raises(ValueError, match="stream"):
         build(RunConfig(stencil="heat3d", grid=(16, 16, 128), iters=8,
                         fuse=4, fuse_kind="stream", mesh=(2, 1, 1)))
-    # y-sharded mesh: the slab-splice design is z-only
+    # y-sharded mesh (round 8): stream now BUILDS via the 2-axis
+    # sliding-window kernel — the forced kind must actually run it
+    # (builder introspection), never silently fall back
+    st_y, step_y, _, _ = build(
+        RunConfig(stencil="heat3d", grid=(48, 64, 128), iters=8,
+                  fuse=4, fuse_kind="stream", mesh=(1, 2, 1)))
+    assert getattr(step_y, "_padfree_kind", None) == "stream_yz"
+    # ... but stays guard-frame on 2-axis meshes too
     with pytest.raises(ValueError, match="stream"):
         build(RunConfig(stencil="heat3d", grid=(48, 64, 128), iters=8,
-                        fuse=4, fuse_kind="stream", mesh=(1, 2, 1)))
+                        fuse=4, fuse_kind="stream", mesh=(2, 2, 1),
+                        periodic=True))
     # forced padfree under a mesh builds the slab-operand kernels with
     # NO padded fallback: an untileable local block raises (local z = 4
     # is below the 2m=8 tile granularity)

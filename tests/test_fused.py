@@ -374,7 +374,13 @@ def test_padfree_y_sharded_mesh_takes_two_axis_kernel():
     "name,grid,nz,k,kw",
     [
         ("heat3d", (32, 16, 256), 2, 4, {}),     # bx=128 < X=256: 2 x-tiles
-        ("wave3d", (32, 16, 256), 2, 4, {}),     # two-field, 90 operands
+        # the wave row is slow tier (round-8 budget trim): its 90-operand
+        # build is per-field replication of the heat3d row's 45-operand
+        # machinery (same specs, same selects), and two-field wide-X
+        # coverage stays in the default tier via the streaming x-window
+        # wave test (test_streamfused::test_xwindowed_wave_two_fields)
+        pytest.param("wave3d", (32, 16, 256), 2, 4, {},
+                     marks=pytest.mark.slow),    # two-field, 90 operands
         # sor margin is 8 (halo x 2 phases x k=4): tiles must be
         # multiples of 16 — (8,8,128) correctly DECLINES now (see
         # test_xwin_rejects_invalid_explicit_tiles)

@@ -277,14 +277,20 @@ def test_sharded_stream_xwindowed():
                                    rtol=0, atol=1e-4)
 
 
-def test_sharded_stream_declines_y_mesh_and_periodic():
+def test_sharded_stream_y_mesh_builds_and_periodic_declines():
+    """Round 8: a y-sharded mesh no longer declines — it routes to the
+    2-axis sliding-window kernel (tests/test_twoaxis_stream.py carries
+    the equivalence suite); periodic stays a hard decline on every mesh
+    (the streaming kernels are guard-frame only)."""
     from mpi_cuda_process_tpu import make_mesh
     from mpi_cuda_process_tpu.parallel.stepper import make_sharded_fused_step
 
     st = make_stencil("heat3d")
-    assert make_sharded_fused_step(
+    step = make_sharded_fused_step(
         st, make_mesh((1, 2, 1)), (48, 64, 128), 4, interpret=True,
-        kind="stream") is None
+        kind="stream")
+    assert step is not None
+    assert getattr(step, "_padfree_kind", None) == "stream_yz"
     assert make_sharded_fused_step(
         st, make_mesh((2, 1, 1)), (48, 32, 128), 4, interpret=True,
         kind="stream", periodic=True) is None
