@@ -68,7 +68,12 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
     exchange — overlapK adds the communication-overlapped interior/
     boundary split; needs >= 2 devices; a ``_meshZxY`` suffix pins a
     2-axis (Z, Y, 1) mesh instead — the two-axis pad-free A/B against
-    the z-ring, needs Z*Y devices) | streamK_shard / streamK_meshZxY
+    the z-ring, needs Z*Y devices) | pipeK / pipeK_meshZxY (overlapK
+    PLUS the cross-pass pipelined exchange: the slab-carry scan issues
+    pass i+1's exchange from pass i's shell outputs, a full interior
+    pass ahead of its consumer; forced pad-free on BOTH mesh families
+    so the A/B against overlapK_* prices the pipeline, not a kind
+    change) | streamK_shard / streamK_meshZxY
     (the STREAMING kernel sharded: z-only mesh of all devices /
     a pinned 2-axis mesh via the round-8 y-slab+corner splice — the
     kind x mesh A/B rows) | copy (harness-calibration
@@ -164,6 +169,57 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
         step = make_stream_fused_step(st, grid, step_unit, tiles=tiles)
         if step is None:
             raise ValueError(f"untileable stream k={step_unit} for {grid}")
+    elif compute.startswith("pipe"):
+        # CROSS-PASS pipelined sharded temporal blocking: overlap split
+        # + the slab-carry scan (pass i+1's exchange issued from pass
+        # i's shell outputs).  Forced pad-free on the z-ring AND the
+        # pinned 2-axis mesh — the pipeline rides the slab-operand
+        # kinds only, and the A/B against the overlapK_* rows must
+        # price the pipeline, not a silent kind change (the overlap
+        # _mesh rows are forced pad-free already; the z-ring overlap
+        # rows are auto — read the pair with that caveat).
+        from mpi_cuda_process_tpu import make_mesh, shard_fields
+        from mpi_cuda_process_tpu.parallel.stepper import (
+            make_sharded_fused_step,
+        )
+
+        spec = compute[len("pipe"):]
+        mesh_zy = None
+        if "_mesh" in spec:
+            spec, meshspec = spec.split("_mesh", 1)
+            mz, my = meshspec.split("x", 1)
+            mesh_zy = (int(mz), int(my))
+        step_unit, tiles = _parse_kspec(spec)
+        if tiles is not None:
+            raise ValueError("pipelined labels take no tile spec")
+        n_dev = len(jax.devices())
+        need = mesh_zy[0] * mesh_zy[1] if mesh_zy else 2
+        if n_dev < need:
+            # environmental, not structural: retried on every run
+            raise ValueError(
+                f"pipelined labels need >= {need} devices (have {n_dev})")
+        mesh = make_mesh((mesh_zy[0], mesh_zy[1], 1) if mesh_zy
+                         else (n_dev, 1, 1))
+        step = make_sharded_fused_step(st, mesh, grid, step_unit,
+                                       overlap=True, padfree=True,
+                                       pipeline=True)
+        if step is None:
+            raise ValueError(
+                f"untileable pipelined k={step_unit} for {grid} on "
+                f"mesh {tuple(mesh.shape.values())}")
+        if not getattr(step, "_pipeline_active", False):
+            raise ValueError(
+                "pipelined label did not build the slab-carry scan — "
+                "must not price a different schedule under this label")
+        if not getattr(step, "_overlap_active", False):
+            raise ValueError(
+                "untileable overlap split under a pipelined label "
+                "(local extent < 3m) — must not price the non-split "
+                "body under this label")
+        mk = lambda: shard_fields(  # noqa: E731
+            init_state(st, grid, kind="auto"), mesh, st.ndim)
+        # make_runner (inside _time_scan) threads the slab carry
+        return _time_scan(step, mk, grid, steps, reps, step_unit)
     elif compute.startswith("overlap") or compute.startswith("shfused"):
         # sharded temporal blocking over a z-only mesh of ALL devices:
         # shfusedK = exchange-then-compute (the A row), overlapK = the
@@ -543,6 +599,24 @@ CONFIGS = [
     # bf16 temporal-blocking path; the 2-axis tiled kernels need k=8)
     ("wave3d_512_bf16_stream4_mesh8x8", "wave3d", (512, 512, 512), 8,
      "bfloat16", "stream4_mesh8x8"),
+    # D10 (round 9): CROSS-PASS PIPELINED exchange A/B — the slab-carry
+    # scan (pass i+1's exchange issued from pass i's shell outputs, one
+    # full interior pass of hiding) against the round-6 overlap rows on
+    # both mesh families.  Forced pad-free on the z-ring too (the
+    # pipeline rides the slab-operand kinds), so read the z-ring pair
+    # with the kind caveat in measure()'s docstring; the _mesh8x8 pair
+    # is kind-clean (both forced pad-free).  The strong-scaling regime
+    # (small per-chip blocks, interior shrinking faster than faces) is
+    # where the gap should open — these 512^3 rows on a big slice are
+    # exactly that regime.
+    ("heat3d_512_f32_pipe4", "heat3d", (512, 512, 512), 10, "float32",
+     "pipe4"),
+    ("heat3d_512_f32_pipe4_mesh8x8", "heat3d", (512, 512, 512), 10,
+     "float32", "pipe4_mesh8x8"),
+    ("wave3d_512_f32_pipe4", "wave3d", (512, 512, 512), 8, "float32",
+     "pipe4"),
+    ("wave3d_512_f32_pipe4_mesh8x8", "wave3d", (512, 512, 512), 8,
+     "float32", "pipe4_mesh8x8"),
 ]
 
 # Tier-D labels: new large Mosaic compiles.  A hang here is plausibly a
@@ -565,7 +639,10 @@ _RISKY = frozenset(
 # CODE, not the config (round-3 advisor finding).
 # rev 7: the 2-axis streaming kernel (build_stream_2axis_call) — forced
 # stream on y-sharded meshes went from None to buildable.
-BUILDER_REV = 7
+# rev 8: the slab-carry pipelined stepper (pipeline=True) — new pipeK
+# labels exist, and the pad-free builders are now constructed through
+# one more wrapper layer (pipeline bodies), so older declines retry.
+BUILDER_REV = 8
 
 
 def _skip_cached(cached):

@@ -77,7 +77,7 @@ def _time_run(run, fields, reps: int) -> float:
 
 
 def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
-                 fuse=0, fuse_kind=None):
+                 fuse=0, fuse_kind=None, pipeline=False):
     import jax
 
     from mpi_cuda_process_tpu import (
@@ -88,6 +88,8 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
     n_dev = math.prod(mesh_shape)
     step_unit = 1
     kernel_kind = None  # which slab-operand kernel carried the rung
+    if pipeline and n_dev == 1:
+        return None  # no exchange to pipeline on the 1-device rung
     if n_dev > 1:
         mesh = make_mesh(mesh_shape)
         if fuse > 1:
@@ -103,18 +105,28 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
 
             step = make_sharded_temporal_step(st, mesh, global_shape, fuse,
                                               kind=fuse_kind,
-                                              overlap=overlap)
+                                              overlap=overlap,
+                                              pipeline=pipeline)
             if step is None:
                 return None
             if overlap and not getattr(step, "_overlap_active", False):
                 # a row labeled overlap=true must not silently price the
                 # plain step (geometry declined the split)
                 return None
+            if pipeline and not getattr(step, "_pipeline_active", False):
+                # a row labeled pipeline=true must not silently price the
+                # per-pass exchange schedule
+                return None
             if fuse_kind == "stream" and not str(
                     getattr(step, "_padfree_kind", "")).startswith(
                         "stream"):
                 # a stream-labeled rung must not silently price another
                 # kernel class
+                return None
+            if fuse_kind == "padfree" and not str(
+                    getattr(step, "_padfree_kind", "")).startswith(
+                        ("zslab", "yzslab")):
+                # same contract for forced pad-free rungs
                 return None
             kernel_kind = getattr(step, "_padfree_kind", None)
             step_unit = fuse
@@ -136,7 +148,8 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
         else:
             from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
 
-            step = make_fused_step(st, global_shape, fuse)
+            step = make_fused_step(st, global_shape, fuse,
+                                   padfree=fuse_kind == "padfree")
         if step is None:
             return None
         step_unit = fuse
@@ -225,16 +238,32 @@ def main(argv=None) -> int:
                         "blocked steppers (rungs that cannot host the "
                         "split are skipped, not silently run plain)")
     p.add_argument("--fuse-kind", default=None,
-                   choices=["stream"],
+                   choices=["stream", "padfree"],
                    help="force the streaming (sliding-window manual-DMA) "
-                        "kernel for --fuse rungs — A/B vs the default "
-                        "zslab/windowed kernels (virtual meshes: relative "
+                        "or pad-free slab-operand kernels for --fuse "
+                        "rungs — A/B vs the default zslab/windowed "
+                        "kernels (virtual meshes: relative "
                         "evidence only).  Composes with --mesh-axes 1|2: "
                         "the 1-axis ladder runs the z-slab streaming "
                         "kernel, the 2-axis ladder the round-8 "
                         "y-slab+corner splice variant — run both for the "
                         "kind x mesh A/B pair; rungs that would price a "
                         "different kernel class are skipped")
+    p.add_argument("--pipeline", action="store_true",
+                   help="cross-pass pipelined exchange rungs (slab-carry "
+                        "scan, stepper pipeline=True): pass i+1's "
+                        "exchange issued from pass i's shell outputs — "
+                        "the A/B against the same ladder without "
+                        "--pipeline prices the cross-pass hiding.  Needs "
+                        "--fuse; composes with --overlap and --mesh-axes "
+                        "1|2; defaults --fuse-kind to padfree (the "
+                        "pipeline rides the slab-operand kinds only); "
+                        "1-device rungs and rungs that cannot host the "
+                        "slab-carry scan are skipped, never silently "
+                        "priced as per-pass rows.  Every emitted row "
+                        "stamps the pipeline flag, so relative CPU "
+                        "evidence and future real-slice rows stay "
+                        "distinguishable")
     p.add_argument("--fuse", type=int, default=0,
                    help="temporal blocking: k fused micro-steps per "
                         "width-k exchange (weak/strong modes; meshes keep "
@@ -249,6 +278,14 @@ def main(argv=None) -> int:
                         "rungs — run both for the decomposition-shape "
                         "A/B against the same grid")
     a = p.parse_args(argv)
+    if a.pipeline:
+        if not (a.fuse > 1):
+            p.error("--pipeline needs --fuse K (the slab-carry scan "
+                    "pipelines the fused passes)")
+        if a.fuse_kind is None:
+            # the pipeline rides the slab-operand kinds; pin the kernel
+            # class so every rung of the ladder prices the same kernel
+            a.fuse_kind = "padfree"
     # --fuse + --overlap now composes: the temporal-blocked steppers carry
     # their own interior/boundary split (stepper.make_sharded_fused_step
     # overlap=True), so the pair emits the overlap A/B ladder for the
@@ -310,10 +347,13 @@ def main(argv=None) -> int:
                 continue
         got = bench_config(
             st, mesh_shape, global_shape, a.steps, a.reps,
-            overlap=a.overlap, fuse=a.fuse, fuse_kind=a.fuse_kind)
+            overlap=a.overlap, fuse=a.fuse, fuse_kind=a.fuse_kind,
+            pipeline=a.pipeline)
         if got is None:
             print(f"[scaling] skip {mesh_shape}: untileable fused "
-                  f"k={a.fuse}", file=sys.stderr)
+                  f"k={a.fuse}"
+                  + (" (or cannot host --pipeline)" if a.pipeline
+                     else ""), file=sys.stderr)
             continue
         mcells, per_step, kernel_kind = got
         per_dev = mcells / n_dev
@@ -325,6 +365,7 @@ def main(argv=None) -> int:
         rec = {
             "mode": a.mode, "stencil": a.stencil,
             "overlap": a.overlap, "fuse": a.fuse,
+            "pipeline": a.pipeline,
             "fuse_kind": a.fuse_kind,
             "kernel_kind": kernel_kind,
             "mesh_axes": a.mesh_axes,

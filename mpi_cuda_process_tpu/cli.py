@@ -82,6 +82,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "composes with --fuse under --mesh (the width-m "
                         "slab exchange then overlaps the interior fused "
                         "kernel, boundary shells spliced after)")
+    p.add_argument("--pipeline", action="store_true",
+                   help="cross-pass pipelined halo exchange (slab-carry "
+                        "scan): the exchanged slabs ride the scan carry, "
+                        "so pass i+1's width-m exchange is issued from "
+                        "pass i's boundary-shell outputs — one FULL "
+                        "interior pass ahead of its consumer instead of "
+                        "the shell-to-splice tail (the strong-scaling "
+                        "regime where the interior shrinks faster than "
+                        "the faces).  Needs --fuse + --mesh and a "
+                        "slab-operand kind (--fuse-kind padfree|stream "
+                        "or an auto-pad-free block); composes with "
+                        "--overlap (the combination that makes the "
+                        "exchange independent of the interior in both "
+                        "directions).  Never silently falls back: "
+                        "periodic meshes, 2D grids, and the padded kind "
+                        "raise with the reason")
     p.add_argument("--dump-every", type=int, default=0,
                    help="async-dump field0 snapshots every N steps (.npy, "
                         "non-blocking via the native writer pool)")
@@ -152,7 +168,8 @@ def config_from_args(argv=None) -> RunConfig:
         checkpoint_every=a.checkpoint_every, checkpoint_dir=a.checkpoint_dir,
         checkpoint_backend=a.checkpoint_backend,
         resume=a.resume, render=a.render, profile_dir=a.profile_dir,
-        compute=a.compute, overlap=a.overlap, ensemble=a.ensemble,
+        compute=a.compute, overlap=a.overlap, pipeline=a.pipeline,
+        ensemble=a.ensemble,
         fuse=a.fuse, fuse_kind=a.fuse_kind,
         tol=a.tol, tol_check_every=a.tol_check_every,
         check_finite=a.check_finite, debug_checks=a.debug_checks,
@@ -252,7 +269,8 @@ def maybe_auto_fuse(cfg: RunConfig) -> RunConfig:
     if k is None:
         return cfg
     if (cfg.periodic or cfg.tol > 0 or cfg.debug_checks or cfg.ensemble
-            or cfg.overlap or cfg.resume or _uses_mesh(cfg) or cfg.mesh):
+            or cfg.overlap or cfg.pipeline or cfg.resume
+            or _uses_mesh(cfg) or cfg.mesh):
         return cfg
     cadences = [cfg.iters, cfg.log_every, cfg.checkpoint_every,
                 cfg.check_finite, cfg.dump_every]
@@ -405,6 +423,13 @@ def build(cfg: RunConfig):
         # upgrades into a kernel that was never probed (and silently no-op
         # off-TPU) — require the explicit pairing
         raise ValueError("--fuse-kind requires an explicit --fuse K")
+    if cfg.pipeline and not cfg.fuse:
+        # a requested pipeline must never be silently ignored (the
+        # forced-flag contract): without temporal blocking there are no
+        # fused passes for the slab carry to span
+        raise ValueError("--pipeline requires an explicit --fuse K "
+                         "(the slab-carry scan pipelines the exchange "
+                         "across fused passes)")
     if cfg.fuse:
         if cfg.compute == "pallas":
             raise ValueError("--fuse replaces the whole step; it excludes "
@@ -414,6 +439,11 @@ def build(cfg: RunConfig):
                 "--overlap with --fuse needs --mesh: the split overlaps "
                 "the halo exchange with the interior kernel, and an "
                 "unsharded run has no exchange to overlap")
+        if cfg.pipeline and not use_mesh:
+            raise ValueError(
+                "--pipeline needs --mesh: the slab-carry scan pipelines "
+                "the width-m halo exchange across fused passes, and an "
+                "unsharded run has no exchange to pipeline")
         if cfg.fuse_kind != "auto" and (
                 st.ndim == 2
                 or (use_mesh and cfg.fuse_kind not in ("stream",
@@ -433,18 +463,21 @@ def build(cfg: RunConfig):
                                                       "padfree") else None
             fused = stepper_lib.make_sharded_temporal_step(
                 st, m, cfg.grid, cfg.fuse, periodic=cfg.periodic,
-                kind=kind, overlap=cfg.overlap)
+                kind=kind, overlap=cfg.overlap, pipeline=cfg.pipeline)
             if cfg.overlap and fused is not None and \
                     not getattr(fused, "_overlap_active", False):
                 log.warning(
                     "--overlap: block geometry cannot host the interior/"
                     "boundary split (local extent < 3*k*halo*phases on a "
                     "sharded axis); running the plain exchange-then-"
-                    "compute fused step")
+                    "compute fused step"
+                    + (" (the slab-carry pipeline stays active on the "
+                       "non-split body)" if cfg.pipeline else ""))
             if fused is None:
                 raise ValueError(
                     f"--fuse {cfg.fuse} + --mesh {cfg.mesh}"
                     + (f" --fuse-kind {kind}" if kind else "")
+                    + (" --pipeline" if cfg.pipeline else "")
                     + f" unsupported for {st.name} on {cfg.grid}: needs a "
                     f"fused kernel, an unsharded lane axis"
                     + (", guard-frame BCs, local z >= 3 chunks of >= "
@@ -640,7 +673,7 @@ def _check_mem_budget(cfg: RunConfig) -> None:
             st, cfg.grid, mesh=cfg.mesh, fuse=cfg.fuse,
             ensemble=cfg.ensemble, periodic=cfg.periodic,
             compute=compute, fuse_kind=cfg.fuse_kind,
-            overlap=cfg.overlap)
+            overlap=cfg.overlap, pipeline=cfg.pipeline)
     except ValueError:
         if cfg.mem_check == "error":
             raise

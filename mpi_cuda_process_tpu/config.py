@@ -35,6 +35,10 @@ class RunConfig:
     profile_dir: Optional[str] = None
     compute: str = "auto"  # auto | jnp | pallas
     overlap: bool = False  # explicit interior/boundary split for comm overlap
+    # cross-pass pipelined halo exchange (slab-carry scan): pass i+1's
+    # exchange issued from pass i's shell outputs, one interior pass ahead
+    # of its consumer; needs --fuse + --mesh + a slab-operand kind
+    pipeline: bool = False
     ensemble: int = 0  # >0: batch of independent universes via vmap
     fuse: int = 0  # >0: temporal blocking, k steps per HBM pass (experimental)
     # which fused kernel carries --fuse (3D unsharded only; auto = measured

@@ -136,18 +136,48 @@ def make_ensemble_step(step_fn):
     return jax.vmap(step_fn)
 
 
+def pipeline_hooks(step_fn):
+    """``(seed, advance)`` normalizing slab-carry pipelined steppers.
+
+    A pipelined sharded stepper (``stepper.make_sharded_fused_step
+    (pipeline=True)``) exposes ``_pipeline_prologue(fields) -> slabs``
+    and ``_pipeline_body(fields, slabs) -> (fields, slabs)``: the
+    exchanged halo slabs ride the scan carry so each pass's exchange is
+    issued one full interior pass ahead of its consumer.  For plain
+    steppers the extra carry is an empty tuple, so every runner below
+    threads the same ``(fields, extra)`` shape regardless.
+    """
+    if getattr(step_fn, "_pipeline_active", False):
+        return step_fn._pipeline_prologue, step_fn._pipeline_body
+
+    def seed(fields):
+        return ()
+
+    def advance(fields, extra):
+        return step_fn(fields), ()
+
+    return seed, advance
+
+
 def make_runner(step_fn, n_steps: int, jit: bool = True):
     """Wrap ``step_fn`` in a donated, jitted ``lax.scan`` over ``n_steps``.
 
     Donation of the carry means the two time levels reuse the same buffers —
     the free equivalent of the reference's (intended) d_univ/d_new_univ swap.
+
+    Slab-carry pipelined steppers (``pipeline_hooks``) are threaded
+    through the scan carry: one prologue exchange seeds the slabs before
+    the scan, each body pass consumes them and emits the next pass's,
+    and the final pass's in-flight slabs are dropped (the epilogue).
     """
+    seed, advance = pipeline_hooks(step_fn)
 
     def run(fields: Fields) -> Fields:
         def body(carry, _):
-            return step_fn(carry), None
+            return advance(*carry), None
 
-        out, _ = lax.scan(body, fields, None, length=n_steps)
+        (out, _extra), _ = lax.scan(
+            body, (fields, seed(fields)), None, length=n_steps)
         return out
 
     if jit:
@@ -188,9 +218,11 @@ def make_checked_runner(step_fn, n_steps: int, start_step: int = 0,
     """
     from jax.experimental import checkify
 
+    seed, advance = pipeline_hooks(step_fn)
+
     if use_checkify:
         def body(carry, idx):
-            new = step_fn(carry)
+            new, extra = advance(*carry)
             for i, f in enumerate(new):
                 if jnp.issubdtype(f.dtype, jnp.inexact):
                     checkify.check(
@@ -199,11 +231,12 @@ def make_checked_runner(step_fn, n_steps: int, start_step: int = 0,
                         "(NaN/Inf blow-up — check stability parameters)" % i,
                         step=idx,
                     )
-            return new, None
+            return (new, extra), None
 
         def run(fields: Fields, start) -> Fields:
-            out, _ = lax.scan(
-                body, fields, start + jnp.arange(n_steps, dtype=jnp.int32))
+            (out, _extra), _ = lax.scan(
+                body, (fields, seed(fields)),
+                start + jnp.arange(n_steps, dtype=jnp.int32))
             return out
 
         checked = jax.jit(checkify.checkify(
@@ -219,19 +252,20 @@ def make_checked_runner(step_fn, n_steps: int, start_step: int = 0,
         return runner
 
     def body(carry, idx):
-        fields, bad_step, bad_field = carry
-        new = step_fn(fields)
+        fields, extra, bad_step, bad_field = carry
+        new, extra = advance(fields, extra)
         for i, f in enumerate(new):
             if not jnp.issubdtype(f.dtype, jnp.inexact):
                 continue
             newly = (bad_step < 0) & ~jnp.isfinite(f).all()
             bad_field = jnp.where(newly, i, bad_field)
             bad_step = jnp.where(newly, idx, bad_step)
-        return (new, bad_step, bad_field), None
+        return (new, extra, bad_step, bad_field), None
 
     def run(fields: Fields, start):
-        init = (fields, jnp.asarray(-1, jnp.int32), jnp.asarray(-1, jnp.int32))
-        (out, bad_step, bad_field), _ = lax.scan(
+        init = (fields, seed(fields),
+                jnp.asarray(-1, jnp.int32), jnp.asarray(-1, jnp.int32))
+        (out, _extra, bad_step, bad_field), _ = lax.scan(
             body, init, start + jnp.arange(n_steps, dtype=jnp.int32))
         return out, bad_step, bad_field
 
@@ -271,29 +305,39 @@ def run_until(
     over a sharded array makes XLA insert the global collective.
 
     Returns ``(fields, steps_done, residual)``.
+
+    Slab-carry pipelined steppers thread their carried slabs through
+    BOTH loops (fori chunk and while carry), so the pipeline stays
+    primed across residual checks — one prologue exchange per run, not
+    per chunk.
     """
     if check_every < 1:
         raise ValueError("check_every must be >= 1")
 
+    seed, advance = pipeline_hooks(step_fn)
+
     def cond(carry):
-        _, n, res = carry
+        _, _, n, res = carry
         return (res > tol) & (n < max_steps)
 
     def body(carry):
-        fs, n, _ = carry
+        fs, extra, n, _ = carry
         # clamp the last chunk so max_steps is a hard cap even when it is
         # not a multiple of check_every
         this_chunk = jnp.minimum(check_every, max_steps - n)
-        new = lax.fori_loop(0, this_chunk, lambda _, c: step_fn(c), fs)
+        new, extra = lax.fori_loop(
+            0, this_chunk, lambda _, c: advance(*c), (fs, extra))
         res = jnp.asarray(0.0, jnp.float32)
         for a, b in zip(new, fs):
             d = jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))
             res = jnp.maximum(res, d)
-        return new, n + this_chunk, res
+        return new, extra, n + this_chunk, res
 
     def run(fs):
-        init = (fs, jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, jnp.float32))
-        return lax.while_loop(cond, body, init)
+        init = (fs, seed(fs), jnp.asarray(0, jnp.int32),
+                jnp.asarray(jnp.inf, jnp.float32))
+        out, _extra, n, res = lax.while_loop(cond, body, init)
+        return out, n, res
 
     if jit:
         run = jax.jit(run, donate_argnums=0)

@@ -85,6 +85,54 @@ def exchange_slabs_axis(
     return from_left, from_right
 
 
+def exchange_slabs_from_borders(
+    lo_rows: jax.Array,
+    hi_rows: jax.Array,
+    axis: int,
+    axis_name: Optional[str],
+    n_shards: int,
+    halo: int,
+    bc_value,
+    periodic: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """``exchange_slabs_axis`` with the SENDER-side border slabs supplied
+    directly instead of sliced from the block.
+
+    The slab-carry pipelined stepper (``stepper.make_sharded_fused_step
+    (pipeline=True)``) issues pass i+1's exchange from pass i's boundary
+    SHELL outputs — the width-``halo`` border rows of the pass's output
+    that never touch the interior kernel — so the ``ppermute`` feeding
+    the next pass carries no data dependency on ``interior(i)`` and XLA
+    can schedule it across the whole interior pass.  ``lo_rows`` /
+    ``hi_rows`` are this shard's FIRST / LAST ``halo`` rows along
+    ``axis``; the return contract is identical to
+    :func:`exchange_slabs_axis` (what belongs just before / after this
+    shard's rows, bc-substituted at non-periodic walls).
+    """
+    if axis_name is None or n_shards == 1:
+        if periodic:
+            return hi_rows, lo_rows
+        bc = jnp.asarray(bc_value, lo_rows.dtype)
+        left = jnp.full(lo_rows.shape, bc, lo_rows.dtype)
+        return left, left
+
+    down = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    up = [(i, (i - 1) % n_shards) for i in range(n_shards)]
+    if not periodic:
+        down = down[:-1]
+        up = up[1:]
+    from_left = lax.ppermute(hi_rows, axis_name, down)
+    from_right = lax.ppermute(lo_rows, axis_name, up)
+
+    if not periodic:
+        idx = lax.axis_index(axis_name)
+        bc = jnp.asarray(bc_value, lo_rows.dtype)
+        from_left = jnp.where(idx == 0, bc, from_left)
+        from_right = jnp.where(idx == n_shards - 1, bc, from_right)
+
+    return from_left, from_right
+
+
 def exchange_slabs_2axis(
     x: jax.Array,
     axis_names: Sequence[Optional[str]],
@@ -117,6 +165,44 @@ def exchange_slabs_2axis(
         x, 0, axis_names[0], shard_counts[0], halo, bc_value, periodic)
     ylo, yhi = exchange_slabs_axis(
         x, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic)
+    c_ll, c_lh = exchange_slabs_axis(
+        zlo, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic)
+    c_hl, c_hh = exchange_slabs_axis(
+        zhi, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic)
+    return (zlo, zhi), (ylo, yhi), (c_ll, c_lh, c_hl, c_hh)
+
+
+def exchange_slabs_2axis_from_borders(
+    z_lo: jax.Array,
+    z_hi: jax.Array,
+    y_lo: jax.Array,
+    y_hi: jax.Array,
+    axis_names: Sequence[Optional[str]],
+    shard_counts: Sequence[int],
+    halo: int,
+    bc_value,
+    periodic: bool = False,
+) -> Tuple[Tuple[jax.Array, jax.Array],
+           Tuple[jax.Array, jax.Array],
+           Tuple[jax.Array, jax.Array, jax.Array, jax.Array]]:
+    """:func:`exchange_slabs_2axis` from supplied border rows.
+
+    ``z_lo``/``z_hi`` are this shard's first/last ``halo`` rows along
+    grid axis 0 (full y extent), ``y_lo``/``y_hi`` along axis 1 (full z
+    extent) — in the pipelined stepper these come from the boundary
+    SHELL outputs (z shells span full y, y shells full z), never from
+    the interior.  Corners ride the identical two-pass composition: the
+    y-exchange OF the received z slabs — the received slabs carry the
+    neighbor's full-y border rows, so their own y-borders are exactly
+    the corner blocks a diagonal hop would send.  Return contract
+    matches :func:`exchange_slabs_2axis`.
+    """
+    zlo, zhi = exchange_slabs_from_borders(
+        z_lo, z_hi, 0, axis_names[0], shard_counts[0], halo, bc_value,
+        periodic)
+    ylo, yhi = exchange_slabs_from_borders(
+        y_lo, y_hi, 1, axis_names[1], shard_counts[1], halo, bc_value,
+        periodic)
     c_ll, c_lh = exchange_slabs_axis(
         zlo, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic)
     c_hl, c_hh = exchange_slabs_axis(

@@ -85,6 +85,24 @@ def _axis_slice(x, d, sl):
     return x[tuple(idx)]
 
 
+def _attach_pipeline(stepper, prologue, body, interior_step=None):
+    """Mark a stepper as slab-carry pipelined and expose the scan hooks.
+
+    ``prologue(fields) -> slabs`` is the seed exchange (run once before a
+    scan); ``body(fields, slabs) -> (fields, slabs)`` is one fused pass
+    that CONSUMES the carried slabs and ISSUES the next pass's exchange.
+    The scan-aware runners in driver.py thread the carry; calling the
+    stepper plainly (``stepper(fields)``) runs prologue + one body pass
+    and drops the trailing slabs — the same values, no pipelining."""
+    stepper._pipeline_active = True
+    stepper._pipeline_prologue = prologue
+    stepper._pipeline_body = body
+    if interior_step is not None:
+        stepper._overlap_active = True
+        stepper._interior_step = interior_step
+    return stepper
+
+
 def _attach_overlap(step, interior_step):
     """Wrap a shard_map'd overlap step so tests/tools can reach the
     interior-only computation (``_interior_step``) and detect that the
@@ -267,6 +285,7 @@ def make_sharded_fused_step(
     padfree: Optional[bool] = None,
     kind: Optional[str] = None,
     overlap: bool = False,
+    pipeline: bool = False,
 ):
     """Temporal blocking under domain decomposition: k steps per exchange.
 
@@ -349,6 +368,31 @@ def make_sharded_fused_step(
     carries ``_overlap_active=True`` and an ``_interior_step`` attribute
     (the interior's exact dependency path, for jaxpr inspection) when
     the split is live.
+
+    ``pipeline=True`` selects the CROSS-PASS pipelined exchange — the
+    slab-carry scan: instead of issuing each pass's width-``m`` exchange
+    at pass start (where only that pass's own interior can hide it), the
+    exchanged slabs ride the ``lax.scan`` carry, seeded by one prologue
+    exchange before the scan.  Each scan body consumes the carried slabs
+    and issues the NEXT pass's exchange from this pass's output borders;
+    composed with ``overlap=True`` those borders are read from the
+    boundary SHELL outputs — which never touch the interior kernel — so
+    the ``ppermute`` feeding pass i+1 is independent of interior(i) in
+    BOTH directions and XLA gets an entire interior pass to hide each
+    exchange behind (the strong-scaling fix: when the interior shrinks
+    faster than the faces, the shell-to-splice tail of a single pass no
+    longer bounds the hideable window).  Values are unchanged (the slabs
+    carry the same bytes the per-pass exchange would fetch — bit-exact
+    vs ``pipeline=False``).  Only the slab-operand kinds host it (the
+    slabs must be separate kernel operands to ride the carry):
+    ``kind='padfree'``/``'stream'`` or an auto-pad-free local block —
+    the exchange-padded kernel raises, as does ``periodic=True`` (the
+    wrap slabs of an unsharded wall axis would be borders of the spliced
+    output, an interior dependency); a requested pipeline NEVER silently
+    falls back.  The returned stepper exposes ``_pipeline_active`` plus
+    ``_pipeline_prologue``/``_pipeline_body`` (the scan hooks driver.py
+    threads); the prologue runs once per scan, and the final pass's
+    in-flight slabs are dropped (one epilogue exchange of waste).
     """
     from ..ops.pallas.fused import (
         build_fused_call,
@@ -363,6 +407,19 @@ def make_sharded_fused_step(
         # auto-selected kernel under the wrong label
         raise ValueError(f"unknown sharded fused kind {kind!r} "
                          "(None=auto, 'stream', 'padfree')")
+    if pipeline and periodic:
+        # A requested pipeline must never silently fall back (the forced-
+        # kind contract): periodic cannot host the slab-carry scan — the
+        # wrap slabs of an unsharded wall axis are border rows of the
+        # SPLICED step output, i.e. an interior(i) dependency, so the
+        # next-pass exchange could not be issued a full interior pass
+        # ahead of its consumer.
+        raise ValueError(
+            "pipeline=True is guard-frame only: under periodic wrap the "
+            "unsharded-axis slabs derive from the spliced step output "
+            "(an interior dependency), which breaks the one-pass-ahead "
+            "exchange the slab-carry scan promises — drop --pipeline "
+            "for periodic meshes")
     if ndim != 3 or not fused_supported(stencil):
         return None
     axis_names, counts = _resolve_mesh_axes(ndim, mesh)
@@ -388,22 +445,32 @@ def make_sharded_fused_step(
             return _make_yzslab_padfree_step(
                 stencil, mesh, global_shape, local_shape, axis_names,
                 counts, k, interpret, periodic, overlap=overlap,
-                stream=True)
+                stream=True, pipeline=pipeline)
         return _make_zslab_padfree_step(
             stencil, mesh, global_shape, local_shape, axis_names, counts,
             k, build_stream_sharded_call, (1, 1), interpret, periodic,
-            overlap=overlap)
+            overlap=overlap, pipeline=pipeline)
     forced_padfree = kind == "padfree"
     if forced_padfree:
         padfree = True
     if padfree is None:
         padfree = prefer_padfree(stencil, local_shape)
+    if pipeline and not padfree:
+        # never silently pipeline the exchange-padded kernel (it has no
+        # slab operands for the carry to feed) — the caller either forces
+        # a slab-operand kind or drops the pipeline
+        raise ValueError(
+            "pipeline=True rides the slab-operand kinds: the exchanged "
+            "slabs must be separate kernel operands to travel the scan "
+            "carry, and the exchange-padded kernel has none — force "
+            "--fuse-kind padfree or stream (or use a pad-free-eligible "
+            "local block)")
     if padfree:
         if z_only:
             step = _make_zslab_padfree_step(
                 stencil, mesh, global_shape, local_shape, axis_names,
                 counts, k, build_zslab_padfree_call, (9, 3), interpret,
-                periodic, overlap=overlap)
+                periodic, overlap=overlap, pipeline=pipeline)
             if step is None:
                 # whole-row windows exceed VMEM (wide X x multi-field):
                 # the wide-X kernel windows the lane axis too
@@ -412,20 +479,29 @@ def make_sharded_fused_step(
                 step = _make_zslab_padfree_step(
                     stencil, mesh, global_shape, local_shape, axis_names,
                     counts, k, build_zslab_xwin_call, (27, 9), interpret,
-                    periodic, overlap=overlap)
+                    periodic, overlap=overlap, pipeline=pipeline)
         else:
             # y (or y+z) sharded: the 2-axis slab-operand kernels — y
             # slabs + two-pass-composed corner operands, selects on both
             # wall axes; 2D meshes no longer pay the pad transient
             step = _make_yzslab_padfree_step(
                 stencil, mesh, global_shape, local_shape, axis_names,
-                counts, k, interpret, periodic, overlap=overlap)
+                counts, k, interpret, periodic, overlap=overlap,
+                pipeline=pipeline)
         if step is not None:
             return step
         if forced_padfree:
             # a FORCED kind must never silently measure the padded
             # kernel under a pad-free label: callers (cli) raise
             return None
+        if pipeline:
+            # a requested pipeline must never silently run the padded
+            # kernel either — same contract as a forced kind
+            raise ValueError(
+                "pipeline=True: no slab-operand kernel tiles this local "
+                "block, and the exchange-padded fallback cannot host "
+                "the slab-carry scan — change k/mesh/shape or drop "
+                "--pipeline")
         # the pad-free builders declined: fall through to the padded
         # kernel rather than turning a previously-working config into None
     # Periodic keeps frame identically False (no origins needed): wrap
@@ -556,7 +632,8 @@ def make_sharded_fused_step(
 
 def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
                              axis_names, counts, k, build_call, layout,
-                             interpret, periodic, overlap=False):
+                             interpret, periodic, overlap=False,
+                             pipeline=False):
     """shard_map wrapper for the z-slab pad-free fused kernels: width-m
     slab exchange (no concatenation, no padded copy), slabs handed to the
     kernel as operands, frame from SMEM origin scalars.  ``layout`` is
@@ -570,8 +647,17 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
     faces, and the shells are spliced over it.  No exchange-padded copy
     is materialized in either mode (the kinds exist for the 4096^3
     budget); falls back to the plain step when the shell geometry does
-    not fit (local z < 3m)."""
+    not fit (local z < 3m).
+
+    ``pipeline=True``: the slab-carry scan (make_sharded_fused_step
+    docstring) — the exchanged slabs become the scan carry; the body
+    consumes them and issues the next pass's exchange from this pass's
+    output border rows (with ``overlap`` those rows are read from the
+    SHELL outputs, never the spliced interior)."""
     from ..ops.pallas.fused import _halo_per_micro
+
+    if pipeline and periodic:  # guarded again for direct callers
+        raise ValueError("pipeline=True is guard-frame only")
 
     n_core, n_slab = layout
     m = k * _halo_per_micro(stencil)
@@ -611,7 +697,7 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
             args += [f] * n_core + [lo] * n_slab + [hi] * n_slab
         return tuple(call(_origins(), *args))
 
-    if shells is None:
+    if shells is None and not pipeline:
         step = shard_map(
             local_step,
             mesh=mesh,
@@ -637,6 +723,99 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
                                            periodic=periodic)
             args += [f] * n_core + [dlo] * n_slab + [dhi] * n_slab
         return tuple(call(_origins(), *args))
+
+    if pipeline:
+        # ---- slab-carry pipelined variants: the body consumes THIS
+        # pass's carried slabs and issues the NEXT pass's exchange.
+        from .halo import (
+            exchange_pad_axis,
+            exchange_slabs_axis,
+            exchange_slabs_from_borders,
+        )
+
+        def local_prologue(fields: Fields):
+            with jax.named_scope("pipeline_prologue_exchange"):
+                return tuple(
+                    exchange_slabs_axis(f, 0, axis_names[0], counts[0],
+                                        m, bc, periodic=periodic)
+                    for f, bc in zip(fields, stencil.bc_value))
+
+        if shells is None:
+            def local_body(fields: Fields, slabs):
+                args = []
+                for f, (lo, hi) in zip(fields, slabs):
+                    args += [f] * n_core + [lo] * n_slab + [hi] * n_slab
+                out = tuple(call(_origins(), *args))
+                with jax.named_scope("next_pass_exchange"):
+                    new_slabs = tuple(
+                        exchange_slabs_axis(o, 0, axis_names[0],
+                                            counts[0], m, bc,
+                                            periodic=periodic)
+                        for o, bc in zip(out, stencil.bc_value))
+                return out, new_slabs
+        else:
+            def local_body(fields: Fields, slabs):
+                with jax.named_scope("interior_update"):
+                    out = list(local_interior(fields))
+                with jax.named_scope("boundary_update"):
+                    lo_args, hi_args = [], []
+                    for (lo, hi), f, bc in zip(slabs, fields,
+                                               stencil.bc_value):
+                        strip_lo = jnp.concatenate(
+                            [lo, _axis_slice(f, 0, slice(0, 3 * m))],
+                            axis=0)
+                        strip_hi = jnp.concatenate(
+                            [_axis_slice(f, 0, slice(Lz - 3 * m, None)),
+                             hi], axis=0)
+                        strip_lo = exchange_pad_axis(
+                            strip_lo, 1, None, 1, m, bc,
+                            periodic=periodic)
+                        strip_hi = exchange_pad_axis(
+                            strip_hi, 1, None, 1, m, bc,
+                            periodic=periodic)
+                        lo_args += [strip_lo] * 4
+                        hi_args += [strip_hi] * 4
+                    org = _origins()
+                    lo_out = shells[0](org, *lo_args)
+                    hi_out = shells[0](
+                        org + jnp.array([Lz - w, 0], jnp.int32),
+                        *hi_args)
+                    for i in range(nfields):
+                        out[i] = out[i].at[:w].set(lo_out[i])
+                        out[i] = out[i].at[Lz - w:].set(hi_out[i])
+                with jax.named_scope("next_pass_exchange"):
+                    # issued from the SHELL outputs only (the output's
+                    # border-m rows ARE shell rows) — never from the
+                    # spliced array, whose producer chain includes the
+                    # interior kernel: the ppermute feeding pass i+1 is
+                    # independent of interior(i), so XLA can run it
+                    # across the whole next interior pass
+                    new_slabs = tuple(
+                        exchange_slabs_from_borders(
+                            lo_out[i][:m], hi_out[i][w - m:], 0,
+                            axis_names[0], counts[0], m, bc,
+                            periodic=periodic)
+                        for i, bc in enumerate(stencil.bc_value))
+                return tuple(out), new_slabs
+
+        prologue_sm = shard_map(local_prologue, mesh=mesh,
+                                in_specs=(spec,), out_specs=spec,
+                                check_vma=False)
+        body_sm = shard_map(local_body, mesh=mesh, in_specs=(spec, spec),
+                            out_specs=(spec, spec), check_vma=False)
+
+        def stepper(fields: Fields) -> Fields:
+            return body_sm(fields, prologue_sm(fields))[0]
+
+        interior_sm = None
+        if shells is not None:
+            interior_sm = shard_map(local_interior, mesh=mesh,
+                                    in_specs=(spec,), out_specs=spec,
+                                    check_vma=False)
+        step = _attach_pipeline(stepper, prologue_sm, body_sm,
+                                interior_step=interior_sm)
+        step._padfree_kind = kind_name
+        return step
 
     def local_step_overlap(fields: Fields) -> Fields:
         from .halo import exchange_pad_axis, exchange_slabs_axis
@@ -689,7 +868,8 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
 
 def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
                               axis_names, counts, k, interpret, periodic,
-                              overlap=False, stream=False):
+                              overlap=False, stream=False,
+                              pipeline=False):
     """shard_map wrapper for the 2-AXIS pad-free fused kernels
     (y-sharded and y+z-sharded meshes): width-m slab exchange on both
     wall axes plus the four corner pieces by two-pass composition
@@ -717,12 +897,22 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
     slab + 3m local strip with the OTHER axis's exchanged slab/corner
     values as padding (edge strips included: a z-shell's y tails carry
     genuine corner data) — are spliced over it.  Falls back to the
-    plain step when any sharded local extent is < 3m."""
+    plain step when any sharded local extent is < 3m.
+
+    ``pipeline=True``: the slab-carry scan (make_sharded_fused_step
+    docstring) on BOTH wall axes — the full slab+corner operand set
+    rides the carry; the body issues the next pass's exchange from the
+    output border rows (with ``overlap``, read from the z/y SHELL
+    outputs), corners by the same two-pass composition
+    (``halo.exchange_slabs_2axis_from_borders``)."""
     from ..ops.pallas.fused import (
         _halo_per_micro,
         build_yzslab_padfree_call,
         build_yzslab_xwin_call,
     )
+
+    if pipeline and periodic:  # guarded again for direct callers
+        raise ValueError("pipeline=True is guard-frame only")
 
     m = k * _halo_per_micro(stencil)
     gshape = tuple(int(g) for g in global_shape)
@@ -802,7 +992,7 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
         ex = _exchange(fields, names2)
         return tuple(call(_origins(), *_kernel_args(fields, ex)))
 
-    if shells is None:
+    if shells is None and not pipeline:
         step = shard_map(
             local_step,
             mesh=mesh,
@@ -849,6 +1039,94 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
             top = jnp.concatenate([zlo[:, Ly - s3:], c_lh], axis=1)
             bot = jnp.concatenate([zhi[:, Ly - s3:], c_hh], axis=1)
         return jnp.concatenate([top, mid, bot], axis=0)
+
+    if pipeline:
+        # ---- slab-carry pipelined variants on both wall axes: the full
+        # slab+corner operand set rides the carry.
+        from .halo import exchange_slabs_2axis_from_borders
+
+        def local_prologue(fields: Fields):
+            with jax.named_scope("pipeline_prologue_exchange"):
+                return tuple(_exchange(fields, names2))
+
+        if shells is None:
+            def local_body(fields: Fields, slabs):
+                out = tuple(call(_origins(),
+                                 *_kernel_args(fields, slabs)))
+                with jax.named_scope("next_pass_exchange"):
+                    new_slabs = tuple(_exchange(out, names2))
+                return out, new_slabs
+        else:
+            def _border_rows(arr_set, i, d, fields):
+                """This shard's first/last m OUTPUT rows along axis d,
+                read from the SHELL outputs (never the spliced array —
+                its producer chain includes the interior kernel).  An
+                unsharded axis returns don't-care rows: the from-borders
+                exchange substitutes the bc constant without reading
+                them (periodic is excluded up front)."""
+                if d in sharded_axes:
+                    lo = _axis_slice(arr_set[(d, True)][i], d,
+                                     slice(0, m))
+                    hi = _axis_slice(arr_set[(d, False)][i], d,
+                                     slice(w - m, None))
+                    return lo, hi
+                dummy = _axis_slice(fields[i], d, slice(0, m))
+                return dummy, dummy
+
+            def local_body(fields: Fields, slabs):
+                with jax.named_scope("interior_update"):
+                    out = list(local_interior(fields))
+                shell_outs = {}
+                with jax.named_scope("boundary_update"):
+                    origins = _origins()
+                    for d in sharded_axes:
+                        L = local_shape[d]
+                        for lo in (True, False):
+                            strips = [_shell_strip(f, e, d, lo)
+                                      for f, e in zip(fields, slabs)]
+                            args = [s for s in strips for _ in range(4)]
+                            off = [0, 0]
+                            off[d] = 0 if lo else L - w
+                            args = [origins
+                                    + jnp.array(off, jnp.int32)] + args
+                            shell_out = shells[d](*args)
+                            shell_outs[(d, lo)] = shell_out
+                            sl = slice(0, w) if lo else slice(L - w, None)
+                            for i in range(nfields):
+                                out[i] = out[i].at[
+                                    (slice(None),) * d + (sl,)
+                                ].set(shell_out[i])
+                with jax.named_scope("next_pass_exchange"):
+                    new_slabs = []
+                    for i, bc in enumerate(stencil.bc_value):
+                        z_lo, z_hi = _border_rows(shell_outs, i, 0,
+                                                  fields)
+                        y_lo, y_hi = _border_rows(shell_outs, i, 1,
+                                                  fields)
+                        new_slabs.append(
+                            exchange_slabs_2axis_from_borders(
+                                z_lo, z_hi, y_lo, y_hi, names2, counts2,
+                                m, bc, periodic=periodic))
+                return tuple(out), tuple(new_slabs)
+
+        prologue_sm = shard_map(local_prologue, mesh=mesh,
+                                in_specs=(spec,), out_specs=spec,
+                                check_vma=False)
+        body_sm = shard_map(local_body, mesh=mesh, in_specs=(spec, spec),
+                            out_specs=(spec, spec), check_vma=False)
+
+        def stepper(fields: Fields) -> Fields:
+            return body_sm(fields, prologue_sm(fields))[0]
+
+        interior_sm = None
+        if shells is not None:
+            interior_sm = shard_map(local_interior, mesh=mesh,
+                                    in_specs=(spec,), out_specs=spec,
+                                    check_vma=False)
+        step = _attach_pipeline(stepper, prologue_sm, body_sm,
+                                interior_step=interior_sm)
+        step._padfree_kind = kind_name
+        return step
 
     def local_step_overlap(fields: Fields) -> Fields:
         with jax.named_scope("halo_exchange"):
@@ -1050,6 +1328,7 @@ def make_sharded_temporal_step(
     periodic: bool = False,
     kind: Optional[str] = None,
     overlap: bool = False,
+    pipeline: bool = False,
 ):
     """Temporal blocking under decomposition, any dimensionality.
 
@@ -1067,11 +1346,21 @@ def make_sharded_temporal_step(
     boundary split in every kind that hosts it (falls back to the plain
     exchange-then-compute step where the geometry declines — check
     ``getattr(step, "_overlap_active", False)``).
+    ``pipeline=True`` (3D slab-operand kinds only) selects the
+    cross-pass slab-carry scan — a requested pipeline never silently
+    falls back: unsupported hosts (2D, periodic, the padded kind)
+    raise with the reason.
     """
     if stencil.ndim == 2:
+        if pipeline:
+            raise ValueError(
+                "pipeline=True is 3D-only: the 2D whole-local-block "
+                "stepper has no slab-operand kind to carry the scan — "
+                "drop --pipeline for 2D grids")
         return None if kind else make_sharded_fullgrid_step(
             stencil, mesh, global_shape, k, interpret=interpret,
             periodic=periodic, overlap=overlap)
     return make_sharded_fused_step(
         stencil, mesh, global_shape, k, interpret=interpret,
-        periodic=periodic, kind=kind, overlap=overlap)
+        periodic=periodic, kind=kind, overlap=overlap,
+        pipeline=pipeline)

@@ -63,6 +63,7 @@ def estimate_run_bytes(
     compute: str = "auto",
     fuse_kind: str = "auto",
     overlap: bool = False,
+    pipeline: bool = False,
 ) -> Tuple[int, List[Tuple[str, int]]]:
     """Peak per-device live bytes for a run, with a labeled breakdown.
 
@@ -105,10 +106,21 @@ def estimate_run_bytes(
         z_only = all(int(c) == 1 for c in tuple(mesh)[1:])
         lane_whole = all(int(c) == 1 for c in tuple(mesh)[2:])
 
+        def _pipeline_part(slab_set_b):
+            """(label, bytes) for the slab-carry scan: the carried slab
+            set is a persistent scan-carry buffer — while a pass runs,
+            THIS pass's slabs (consumed) and the NEXT pass's (being
+            exchanged) are live together, one extra slab set beyond the
+            per-pass operands counted above."""
+            return ("pipelined carried slabs (slab-carry scan: next "
+                    "pass's exchange lives alongside this pass's "
+                    "operands)", slab_set_b)
+
         def _padfree_slab_part():
-            """(label, bytes) for the sharded slab-operand pad-free path
-            — z-only or 2-axis — or None when no builder tiles this
-            local shape (construction is pure Python, no compile)."""
+            """(label, bytes, base_set_bytes) for the sharded
+            slab-operand pad-free path — z-only or 2-axis — or None when
+            no builder tiles this local shape (construction is pure
+            Python, no compile)."""
             if not lane_whole:
                 return None
             grid_t = tuple(int(g) for g in grid)
@@ -144,13 +156,13 @@ def estimate_run_bytes(
                 slab_cells = (2 * m * ly * lx + 2 * (2 * m) * lz * lx
                               + 4 * m * (2 * m) * lx)
                 what = f"slab+corner operands only (2-axis, width {m}"
-            slab_b = batch * slab_cells * itemsize * nfields
-            if overlap:
-                # dummy interior slabs + the shell strips live alongside
-                # the exchanged slabs during the split
-                slab_b *= 2
+            base_b = batch * slab_cells * itemsize * nfields
+            slab_b = 2 * base_b if overlap else base_b
+            # (overlap: dummy interior slabs + the shell strips live
+            # alongside the exchanged slabs during the split)
             return (f"sharded pad-free: {what}"
-                    f"{', x2 overlap split' if overlap else ''})", slab_b)
+                    f"{', x2 overlap split' if overlap else ''})",
+                    slab_b, base_b)
 
         # The budget must describe the path the stepper will actually
         # take: a pad-free preference that the kernel builder cannot TILE
@@ -191,11 +203,10 @@ def estimate_run_bytes(
                               + 4 * m * (m + m_a) * lx)
                 what = (f"slab+corner operands only (2-axis stream, "
                         f"width {m}, y-aligned {m_a}")
-            slab_b = batch * slab_cells * itemsize * nfields
-            if overlap:
-                # dummy interior slabs + the shell strips live alongside
-                # the exchanged slabs during the split
-                slab_b *= 2
+            base_b = batch * slab_cells * itemsize * nfields
+            # overlap: dummy interior slabs + the shell strips live
+            # alongside the exchanged slabs during the split
+            slab_b = 2 * base_b if overlap else base_b
             parts.append(
                 (f"sharded streaming: {what}"
                  f"{', x2 overlap split' if overlap else ''})"
@@ -203,26 +214,45 @@ def estimate_run_bytes(
                  "sharded streaming: UNBUILDABLE for this mesh/shape "
                  "(the run refuses before allocating)",
                  slab_b if ok else 0))
+            if pipeline and ok:
+                parts.append(_pipeline_part(base_b))
         elif sharded and fuse_kind == "padfree":
             # forced pad-free under a mesh: no padded fallback exists
             # (make_sharded_fused_step returns None and cli raises), so
             # never estimate the padded transient
             part = _padfree_slab_part()
-            parts.append(part if part is not None else (
-                "sharded pad-free: UNBUILDABLE for this mesh/shape — "
-                "no padded fallback under a forced kind (the run "
-                "refuses before allocating)", 0))
+            if part is not None:
+                parts.append(part[:2])
+                if pipeline:
+                    parts.append(_pipeline_part(part[2]))
+            else:
+                parts.append((
+                    "sharded pad-free: UNBUILDABLE for this mesh/shape — "
+                    "no padded fallback under a forced kind (the run "
+                    "refuses before allocating)", 0))
         elif sharded and prefer_padfree(stencil, local, batch=batch) \
                 and _padfree_slab_part() is not None:
             # slab-operand pad-free (stepper._make_zslab_padfree_step /
             # _make_yzslab_padfree_step): the exchanged slabs (+ corner
             # pieces on 2-axis meshes) are the ONLY transient — no
             # padded copy
-            parts.append(_padfree_slab_part())
+            part = _padfree_slab_part()
+            parts.append(part[:2])
+            if pipeline:
+                parts.append(_pipeline_part(part[2]))
         elif sharded:
             # exchange-padded local block per field (stepper.py
             # local_step); the frame comes from SMEM origin scalars, so
             # no mask array exists (round 3 streamed one per step)
+            if pipeline:
+                # the padded kind has no slab operands for the carry to
+                # feed: make_sharded_fused_step raises, so the estimate
+                # must describe the refusal, never a kernel the run
+                # would not take
+                parts.append((
+                    "pipelined sharded fused: UNSUPPORTED on the "
+                    "exchange-padded kind (the run refuses — force "
+                    "--fuse-kind padfree/stream)", 0))
             n_padded = 2 * nfields if overlap else nfields
             # overlap split: the exchange-padded block (shell inputs) and
             # the locally-padded block (interior input) are live together
@@ -323,6 +353,7 @@ def check_budget(
     fuse_kind: str = "auto",
     hbm_bytes: Optional[int] = None,
     overlap: bool = False,
+    pipeline: bool = False,
 ) -> Tuple[int, List[Tuple[str, int]]]:
     """Raise ValueError with the arithmetic when the run cannot fit.
 
@@ -332,7 +363,7 @@ def check_budget(
     total, parts = estimate_run_bytes(
         stencil, grid, mesh=mesh, fuse=fuse, ensemble=ensemble,
         periodic=periodic, compute=compute, fuse_kind=fuse_kind,
-        overlap=overlap)
+        overlap=overlap, pipeline=pipeline)
     if total > hbm:
         raise ValueError(
             f"config needs ~{total / 2**30:.2f} GiB per device but HBM is "

@@ -1,0 +1,205 @@
+"""Jaxpr-structure regression checks for the pipelined/overlapped steps.
+
+The overlap and pipeline steppers make PROMISES about dependency
+structure, not values: the interior kernel must be schedulable
+concurrently with the halo exchange.  Values regress loudly (equivalence
+tests) but structure regresses silently — an innocent refactor that
+routes a slab through the spliced output would keep every number
+bit-identical while serializing the exchange back onto the critical
+path.  This module is the single reusable implementation of the
+structural assertions (grown from the inline pattern of
+tests/test_overlap_fused.py): used by the test suite AND invoked from
+``scripts/tier1.sh`` via ``scripts/check_pipeline_structure.py``, so the
+gate a builder actually runs checks the dependency claims too.
+
+Checked properties of a pipelined body ``(fields, slabs) -> (fields,
+slabs)``:
+
+1. **Exactly one exchange round per scan iteration** — the body's
+   ``ppermute`` count equals the non-pipelined step's (the carry moves
+   the exchange, it must not duplicate or drop transfers).
+2. **Two-sided independence** (with ``overlap=True``): the interior
+   ``pallas_call`` is unreachable from any ``ppermute`` output
+   (interior(i) does not consume the exchange feeding pass i+1), and no
+   ``ppermute`` is reachable from the interior's outputs (the exchange
+   feeding pass i+1 does not consume interior(i)).  Both directions are
+   required for XLA to schedule the transfer across the whole interior
+   pass.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+
+
+def iter_jaxprs(jaxpr):
+    """Yield ``jaxpr`` and every sub-jaxpr reachable through eqn params."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for v in eqn.params.values():
+            vals = v if isinstance(v, (list, tuple)) else (v,)
+            for u in vals:
+                if isinstance(u, jax.core.ClosedJaxpr):
+                    yield from iter_jaxprs(u.jaxpr)
+                elif isinstance(u, jax.core.Jaxpr):
+                    yield from iter_jaxprs(u)
+
+
+def count_primitive(closed, name: str) -> int:
+    """Occurrences of primitive ``name`` across all nested jaxprs."""
+    return sum(
+        1
+        for jx in iter_jaxprs(closed.jaxpr)
+        for eqn in jx.eqns
+        if eqn.primitive.name == name
+    )
+
+
+def _producer_map(jx):
+    producer = {}
+    for eqn in jx.eqns:
+        for ov in eqn.outvars:
+            producer[ov] = eqn
+    return producer
+
+
+def _ancestor_eqns(jx, seeds):
+    """All eqns transitively producing the inputs of ``seeds`` (seeds
+    included)."""
+    producer = _producer_map(jx)
+    seen, stack = set(), list(seeds)
+    out = []
+    while stack:
+        eqn = stack.pop()
+        if id(eqn) in seen:
+            continue
+        seen.add(id(eqn))
+        out.append(eqn)
+        for iv in eqn.invars:
+            if isinstance(iv, jax.core.Literal):
+                continue
+            p = producer.get(iv)
+            if p is not None:
+                stack.append(p)
+    return out
+
+
+def interior_exchange_independence(
+    closed, local_shape: Sequence[int]
+) -> Dict[str, object]:
+    """Two-sided reachability report between the interior ``pallas_call``
+    (the one producing full ``local_shape`` outputs) and every
+    ``ppermute``, inside the (sub-)jaxpr that holds the collectives.
+
+    Returns ``{"n_ppermute", "interior_depends_on_exchange",
+    "exchange_depends_on_interior"}``; raises ``AssertionError`` when no
+    ppermute or no interior pallas_call exists anywhere (a structural
+    check against the wrong function is meaningless).
+    """
+    local_shape = tuple(int(s) for s in local_shape)
+    for jx in iter_jaxprs(closed.jaxpr):
+        perms = [e for e in jx.eqns if e.primitive.name == "ppermute"]
+        if not perms:
+            continue
+        interior = [
+            e for e in jx.eqns
+            if e.primitive.name == "pallas_call"
+            and any(tuple(ov.aval.shape) == local_shape
+                    for ov in e.outvars)
+        ]
+        assert interior, (
+            "no interior pallas_call (full local-shape outputs "
+            f"{local_shape}) in the jaxpr holding the ppermutes")
+        perm_anc = _ancestor_eqns(jx, perms)
+        int_anc = _ancestor_eqns(jx, interior)
+        interior_ids = {id(e) for e in interior}
+        return {
+            "n_ppermute": len(perms),
+            # any ppermute in the interior's producer chain?
+            "interior_depends_on_exchange": any(
+                e.primitive.name == "ppermute" for e in int_anc),
+            # any interior call in a ppermute's producer chain?
+            "exchange_depends_on_interior": any(
+                id(e) in interior_ids for e in perm_anc),
+        }
+    raise AssertionError("no ppermute anywhere — the step did not "
+                        "exchange at all")
+
+
+def assert_pipeline_body_structure(
+    pipelined_step,
+    plain_step,
+    fields,
+    local_shape: Sequence[int],
+    overlap: bool,
+) -> Dict[str, object]:
+    """Assert the pipelined body's structural contract; return the report.
+
+    ``pipelined_step`` must carry the ``_pipeline_prologue`` /
+    ``_pipeline_body`` hooks; ``plain_step`` is the same configuration
+    with ``pipeline=False`` (its ppermute count defines "one exchange
+    round").  ``overlap`` selects whether the two-sided independence is
+    asserted (without the interior/shell split there is no separate
+    interior kernel to be independent OF).
+    """
+    prologue = pipelined_step._pipeline_prologue
+    body = pipelined_step._pipeline_body
+    slabs = jax.eval_shape(prologue, fields)
+    closed_body = jax.make_jaxpr(body)(fields, slabs)
+
+    n_body = count_primitive(closed_body, "ppermute")
+    n_plain = count_primitive(jax.make_jaxpr(plain_step)(fields),
+                              "ppermute")
+    assert n_body == n_plain > 0, (
+        f"pipelined body issues {n_body} ppermutes per iteration, the "
+        f"non-pipelined step {n_plain} — the slab carry must move the "
+        "exchange, not duplicate or drop transfers")
+
+    report: Dict[str, object] = {"n_ppermute": n_body}
+    if overlap:
+        rep = interior_exchange_independence(closed_body, local_shape)
+        assert not rep["interior_depends_on_exchange"], (
+            "interior(i) consumes a ppermute output — the carried slabs "
+            "must be the only exchanged data a pass reads")
+        assert not rep["exchange_depends_on_interior"], (
+            "the exchange feeding pass i+1 consumes interior(i) — next "
+            "slabs must be read from the SHELL outputs, not the spliced "
+            "array")
+        report.update(rep)
+    return report
+
+
+def check_pipeline_structure(
+    stencil_name: str = "heat3d",
+    grid: Tuple[int, int, int] = (32, 16, 128),
+    mesh_shape: Tuple[int, int, int] = (2, 1, 1),
+    k: int = 4,
+    kind=None,
+    padfree=True,
+) -> Dict[str, object]:
+    """Build a pipelined+overlapped step on the current devices and run
+    the full assertion set — the entry point ``scripts/
+    check_pipeline_structure.py`` (and hence ``scripts/tier1.sh``)
+    drives.  Trace-only: nothing executes."""
+    from .. import init_state, make_mesh, make_stencil, shard_fields
+    from ..parallel.stepper import make_sharded_fused_step
+
+    st = make_stencil(stencil_name)
+    mesh = make_mesh(mesh_shape)
+    mk = lambda pipe: make_sharded_fused_step(  # noqa: E731
+        st, mesh, grid, k, interpret=True, kind=kind, padfree=padfree,
+        overlap=True, pipeline=pipe)
+    pipelined, plain = mk(True), mk(False)
+    assert pipelined is not None and plain is not None, (
+        stencil_name, grid, mesh_shape)
+    assert getattr(pipelined, "_pipeline_active", False)
+    assert getattr(pipelined, "_overlap_active", False), \
+        "overlap geometry declined — pick a shape hosting the split"
+    fields = shard_fields(init_state(st, grid, seed=3, kind="pulse"),
+                          mesh, 3)
+    local = tuple(g // c for g, c in
+                  zip(grid, tuple(mesh_shape) + (1,) * 3))
+    return assert_pipeline_body_structure(
+        pipelined, plain, fields, local, overlap=True)

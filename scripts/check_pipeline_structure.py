@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Jaxpr-structure gate for the pipelined halo exchange (tier1.sh).
+
+Value equivalence is covered by the test suite; THIS gate pins the
+dependency-structure claims the perf work rests on (they can regress
+with every number bit-identical): one exchange round per scan iteration,
+and the two-sided interior/exchange independence that lets XLA hide the
+exchange behind a full interior pass.  The shared implementation lives
+in ``mpi_cuda_process_tpu/utils/jaxprcheck.py`` (also used by
+tests/test_pipeline_fused.py); this wrapper forces the CPU backend with
+virtual devices (the cpuforce recipe) and runs the check on a z-only and
+a 2-axis mesh.  Trace-only — a few seconds, no kernel executes.
+"""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from cpuforce import force_cpu  # noqa: E402
+
+force_cpu(8)
+
+
+def main() -> int:
+    from mpi_cuda_process_tpu.utils.jaxprcheck import (
+        check_pipeline_structure,
+    )
+
+    cases = [
+        # z-only ring, pad-free z-slab kernel
+        dict(stencil_name="heat3d", grid=(32, 16, 128),
+             mesh_shape=(2, 1, 1), k=4, padfree=True),
+        # 2-axis mesh: y shells + two-hop corner ppermutes too
+        dict(stencil_name="heat3d", grid=(32, 32, 128),
+             mesh_shape=(2, 2, 1), k=4, padfree=True),
+    ]
+    for case in cases:
+        rep = check_pipeline_structure(**case)
+        print(f"check_pipeline_structure: ok {case['mesh_shape']} "
+              f"(ppermutes/iter={rep['n_ppermute']}, "
+              f"interior->exchange={rep['interior_depends_on_exchange']}, "
+              f"exchange->interior={rep['exchange_depends_on_interior']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,4 +20,8 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 python scripts/check_tier_counts.py || rc=1
+# Dependency-structure gate for the pipelined halo exchange: trace-only
+# (seconds); the perf claims it pins can regress with every value test
+# still green (see scripts/check_pipeline_structure.py).
+python scripts/check_pipeline_structure.py || rc=1
 exit $rc

@@ -289,6 +289,73 @@ def test_fuse_overlap_requires_mesh():
                         fuse=4, overlap=True))
 
 
+def test_pipeline_cli_matches_plain_run():
+    """--pipeline --overlap --fuse K --fuse-kind padfree --mesh: the
+    slab-carry scan through the whole CLI stack (build -> run's
+    pipeline-aware scan runner) changes no values."""
+    base = dict(stencil="heat3d", grid=(32, 16, 128), iters=12,
+                init="random", seed=2)
+    plain, _ = run(RunConfig(**base))
+    st, step_fn, _, _ = build(RunConfig(**base, fuse=4,
+                                        fuse_kind="padfree",
+                                        mesh=(2, 1, 1), overlap=True,
+                                        pipeline=True))
+    assert getattr(step_fn, "_pipeline_active", False)
+    assert getattr(step_fn, "_overlap_active", False)
+    pipe, _ = run(RunConfig(**base, fuse=4, fuse_kind="padfree",
+                            mesh=(2, 1, 1), overlap=True, pipeline=True))
+    np.testing.assert_allclose(
+        np.asarray(pipe[0]), np.asarray(plain[0]), rtol=0, atol=1e-4)
+
+
+def test_pipeline_cli_chunked_cadence_matches_unchunked():
+    """--pipeline + --log-every (cli's scan-over-remaining/K chunking):
+    every chunk re-seeds the carry with its own prologue exchange; the
+    final state must match the single-scan run bit-for-bit."""
+    base = dict(stencil="heat3d", grid=(32, 16, 128), iters=16,
+                init="random", seed=2, fuse=4, fuse_kind="padfree",
+                mesh=(2, 1, 1), pipeline=True)
+    whole, _ = run(RunConfig(**base))
+    chunked, _ = run(RunConfig(**base, log_every=8))
+    np.testing.assert_array_equal(
+        np.asarray(chunked[0]), np.asarray(whole[0]))
+
+
+def test_pipeline_cli_flag_parses():
+    cfg = config_from_args([
+        "--stencil", "heat3d", "--grid", "32,16,128", "--iters", "8",
+        "--mesh", "2,1,1", "--fuse", "4", "--fuse-kind", "padfree",
+        "--overlap", "--pipeline"])
+    assert cfg.pipeline and cfg.overlap and cfg.fuse == 4
+
+
+def test_pipeline_cli_never_silently_falls_back():
+    """A forced --pipeline raises with the reason on every host that
+    cannot carry it — no silent fallback anywhere in the chain."""
+    base = dict(stencil="heat3d", grid=(32, 16, 128), iters=8)
+    with pytest.raises(ValueError, match="pipeline"):
+        build(RunConfig(**base, pipeline=True))  # no --fuse
+    with pytest.raises(ValueError, match="pipeline"):
+        build(RunConfig(**base, fuse=4, pipeline=True))  # no --mesh
+    with pytest.raises(ValueError, match="guard-frame"):
+        build(RunConfig(**base, fuse=4, mesh=(2, 1, 1),
+                        fuse_kind="padfree", periodic=True,
+                        pipeline=True))
+    with pytest.raises(ValueError, match="slab-operand"):
+        # auto kind resolving to the exchange-padded kernel
+        build(RunConfig(**base, fuse=4, mesh=(2, 1, 1), pipeline=True))
+    with pytest.raises(ValueError, match="3D-only"):
+        build(RunConfig(stencil="life", grid=(64, 128), iters=8, fuse=8,
+                        mesh=(2,), params={"dtype": "int32"},
+                        pipeline=True))
+    with pytest.raises(ValueError, match="pipeline"):
+        # forced stream on a geometry stream cannot tile: the None from
+        # the builder must surface as the --pipeline-aware error
+        build(RunConfig(**{**base, "grid": (16, 32, 128)}, fuse=4,
+                        fuse_kind="stream", mesh=(2, 2, 1),
+                        pipeline=True))
+
+
 def test_fuse_kind_stream_matches_plain_run():
     """--fuse K --fuse-kind stream (sliding-window manual-DMA kernel) must
     agree with the plain run to the fused-window tolerance."""

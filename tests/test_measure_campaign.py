@@ -53,7 +53,8 @@ def test_risky_labels_are_new_large_compiles(M):
     for label, name, grid, steps, dtype, compute in M.CONFIGS:
         if label in M._RISKY:
             assert compute.startswith(
-                ("fused", "padfree", "stream", "shfused", "overlap")), label
+                ("fused", "padfree", "stream", "shfused", "overlap",
+                 "pipe")), label
 
 
 def _run_single_label(M, out, label="heat2d_512_f32"):

@@ -1,0 +1,357 @@
+"""Cross-pass pipelined halo exchange == the per-pass exchange, bit-exact.
+
+``make_sharded_fused_step(pipeline=True)`` restructures WHEN the
+width-m exchange is issued — the slabs ride the ``lax.scan`` carry, and
+pass i+1's exchange is issued from pass i's boundary-shell outputs, one
+full interior pass ahead of its consumer — but must never change a
+value: the carried slabs hold exactly the bytes the per-pass exchange
+would fetch, so the equivalence here is pinned BIT-EXACT (assert_array
+_equal, bf16 included), not allclose.
+
+Every equivalence case scans >= 3 iterations through the pipeline-aware
+runner (driver.make_runner threads the carry), so the slabs are
+exercised well past the prologue: iteration 3's shells consume slabs
+exchanged from iteration 2's shell outputs — a stale-carry or
+wrong-border bug cannot survive.
+
+Structure (the perf claim) is asserted through the reusable helper
+(utils/jaxprcheck.py, also invoked by scripts/tier1.sh): exactly one
+exchange round per scan iteration, and — with overlap — the two-sided
+interior/exchange independence.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mpi_cuda_process_tpu import (
+    init_state,
+    make_mesh,
+    make_stencil,
+    shard_fields,
+)
+from mpi_cuda_process_tpu import driver
+from mpi_cuda_process_tpu.driver import make_runner
+from mpi_cuda_process_tpu.parallel.stepper import (
+    make_sharded_fused_step,
+    make_sharded_temporal_step,
+)
+from mpi_cuda_process_tpu.utils.jaxprcheck import (
+    assert_pipeline_body_structure,
+    count_primitive,
+)
+
+
+def _pair(name, grid, mesh_shape, k, kind=None, padfree=None,
+          overlap=False, kw=None):
+    st = make_stencil(name, **(kw or {}))
+    mesh = make_mesh(mesh_shape)
+    mk = lambda pipe: make_sharded_fused_step(  # noqa: E731
+        st, mesh, grid, k, interpret=True, kind=kind, padfree=padfree,
+        overlap=overlap, pipeline=pipe)
+    plain, pipe = mk(False), mk(True)
+    assert plain is not None and pipe is not None, (name, grid, mesh_shape)
+    assert getattr(pipe, "_pipeline_active", False)
+    assert not getattr(plain, "_pipeline_active", False)
+    if overlap:
+        assert getattr(pipe, "_overlap_active", False), \
+            "overlap geometry unexpectedly declined — fix the test shape"
+    return st, mesh, plain, pipe
+
+
+def _run_scanned(st, mesh, step, fields, steps):
+    return make_runner(step, steps)(shard_fields(fields, mesh, 3))
+
+
+def _assert_bitexact(got, ref):
+    for g, r in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+
+# ---------------------------------------------------------------------------
+# The acceptance matrix: heat3d/wave3d/sor3d x (2,1,1)/(2,2,1)/(1,2,1)
+# x padfree/stream x with/without overlap, >= 3 scan iterations.  The
+# default tier keeps one anchor per ingredient (z-only overlap, 2-axis
+# overlap, 2-axis stream with the wave carry field, non-overlap body);
+# redundant combinations ride the slow tier — each slow case names what
+# only it adds.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name,grid,mesh_shape,k,kind,padfree,overlap", [
+    # z-only pad-free, both bodies (the non-overlap body is a different
+    # code path: next slabs exchanged from the kernel output itself)
+    ("heat3d", (32, 16, 128), (2, 1, 1), 4, None, True, False),
+    ("heat3d", (32, 16, 128), (2, 1, 1), 4, None, True, True),
+    # 2-axis pad-free overlap: y shells + two-hop corner re-exchange
+    ("heat3d", (32, 32, 128), (2, 2, 1), 4, None, True, True),
+    # 2-axis stream overlap with the two-field leapfrog carry
+    ("wave3d", (48, 32, 128), (2, 2, 1), 4, "stream", None, True),
+    # 2-axis pad-free non-overlap body (full slab+corner set re-exchanged
+    # from the output)
+    pytest.param("heat3d", (32, 32, 128), (2, 2, 1), 4, None, True, False,
+                 marks=pytest.mark.slow),
+    # y-only degenerate mesh: z slabs are bc dummies every iteration
+    pytest.param("heat3d", (32, 32, 128), (1, 2, 1), 4, None, True, True,
+                 marks=pytest.mark.slow),
+    # z-only stream (slab splice into the sliding window)
+    pytest.param("heat3d", (48, 32, 128), (2, 1, 1), 4, "stream", None,
+                 True, marks=pytest.mark.slow),
+    # y-only stream (corner pieces substitute the z overhang)
+    pytest.param("heat3d", (24, 32, 128), (1, 2, 1), 4, "stream", None,
+                 False, marks=pytest.mark.slow),
+    # wave3d z-only pad-free: carry-field slabs ride the carry too
+    pytest.param("wave3d", (32, 16, 128), (2, 1, 1), 4, None, True, True,
+                 marks=pytest.mark.slow),
+    # red-black parity: m = 2k, shells re-offset, phase order preserved
+    pytest.param("sor3d", (64, 16, 128), (2, 1, 1), 4, None, True, True,
+                 marks=pytest.mark.slow),
+    pytest.param("sor3d", (64, 64, 128), (2, 2, 1), 4, None, True, True,
+                 marks=pytest.mark.slow),
+    pytest.param("sor3d", (96, 32, 128), (2, 2, 1), 4, "stream", None,
+                 False, marks=pytest.mark.slow),
+])
+def test_pipeline_matches_plain(name, grid, mesh_shape, k, kind, padfree,
+                                overlap):
+    st, mesh, plain, pipe = _pair(name, grid, mesh_shape, k, kind=kind,
+                                  padfree=padfree, overlap=overlap)
+    fields = init_state(st, grid, seed=9, kind="pulse")
+    _assert_bitexact(_run_scanned(st, mesh, pipe, fields, 3),
+                     _run_scanned(st, mesh, plain, fields, 3))
+
+
+def test_pipeline_bf16_k4_stream_bitexact():
+    """bf16 at k=4 (stream-only: the tiled kinds need k=8) through the
+    slab-carry scan — bit-exact, not allclose: the carried slabs hold
+    the same bf16 bytes the per-pass exchange would.  Non-overlap body:
+    the overlap SHELLS are tiled-kernel instances whose 2m=8 extent
+    misses the bf16 sublane tile (16), so bf16 k=4 has never hosted the
+    split — the pipeline's k=4 bf16 story is the non-split body (the
+    k=8 pad-free case below covers split+carry in bf16)."""
+    st, mesh, plain, pipe = _pair("heat3d", (48, 32, 128), (2, 2, 1), 4,
+                                  kind="stream", overlap=False,
+                                  kw={"dtype": jnp.bfloat16})
+    fields = init_state(st, (48, 32, 128), seed=9, kind="pulse")
+    _assert_bitexact(_run_scanned(st, mesh, pipe, fields, 3),
+                     _run_scanned(st, mesh, plain, fields, 3))
+
+
+@pytest.mark.slow
+def test_pipeline_bf16_k8_padfree_bitexact():
+    """bf16 on the tiled pad-free kind needs k=8 (2m a multiple of the
+    16-row bf16 sublane tile) — the deep-margin variant of the carry."""
+    st, mesh, plain, pipe = _pair("heat3d", (64, 32, 128), (2, 1, 1), 8,
+                                  padfree=True, overlap=True,
+                                  kw={"dtype": jnp.bfloat16})
+    fields = init_state(st, (64, 32, 128), seed=9, kind="pulse")
+    _assert_bitexact(_run_scanned(st, mesh, pipe, fields, 3),
+                     _run_scanned(st, mesh, plain, fields, 3))
+
+
+# ---------------------------------------------------------------------------
+# scan-boundary edge cases: prologue/epilogue at n_steps 0/1/2, and the
+# K-chunked (log-cadence) path re-seeding the carry per chunk
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_steps", [0, 1, 2])
+def test_pipeline_scan_boundaries(n_steps):
+    """n=0 must return the fields untouched (the prologue exchange is
+    traced but its slabs are dropped by the empty scan); n=1 is pure
+    prologue+epilogue (no carried iteration); n=2 exercises exactly one
+    carry handoff."""
+    st, mesh, plain, pipe = _pair("heat3d", (32, 16, 128), (2, 1, 1), 4,
+                                  padfree=True, overlap=True)
+    fields = init_state(st, (32, 16, 128), seed=5, kind="pulse")
+    _assert_bitexact(_run_scanned(st, mesh, pipe, fields, n_steps),
+                     _run_scanned(st, mesh, plain, fields, n_steps))
+
+
+def test_pipeline_chunked_run_reseeds_carry():
+    """run_simulation's log-cadence chunking (cli's scan-over-remaining/K
+    path) builds one runner per chunk: each chunk re-seeds the carry
+    with a fresh prologue exchange, and the values must still be
+    bit-identical to one unchunked scan."""
+    st, mesh, plain, pipe = _pair("heat3d", (32, 16, 128), (2, 1, 1), 4,
+                                  padfree=True, overlap=True)
+    fields = shard_fields(init_state(st, (32, 16, 128), seed=5,
+                                     kind="pulse"), mesh, 3)
+    seen = []
+    chunked = driver.run_simulation(
+        st, fields, 5, step_fn=pipe, log_every=2,
+        callback=lambda done, fs: seen.append(done))
+    assert seen == [2, 4, 5]  # 2+2+1 calls: three chunks, three prologues
+    fields2 = shard_fields(init_state(st, (32, 16, 128), seed=5,
+                                      kind="pulse"), mesh, 3)
+    unchunked = driver.run_simulation(st, fields2, 5, step_fn=pipe)
+    _assert_bitexact(chunked, unchunked)
+
+
+def test_pipeline_run_until_threads_carry():
+    """--tol's while_loop runner: the carried slabs thread through the
+    fori chunk AND the while carry (one prologue per run), and the
+    converged state equals the per-pass stepper's."""
+    st, mesh, plain, pipe = _pair("heat3d", (32, 16, 128), (2, 1, 1), 4,
+                                  padfree=True, overlap=True)
+    f1 = shard_fields(init_state(st, (32, 16, 128), seed=5, kind="pulse"),
+                      mesh, 3)
+    out_p, n_p, res_p = driver.run_until(pipe, f1, tol=0.0, max_steps=3,
+                                         check_every=2)
+    f2 = shard_fields(init_state(st, (32, 16, 128), seed=5, kind="pulse"),
+                      mesh, 3)
+    out_r, n_r, res_r = driver.run_until(plain, f2, tol=0.0, max_steps=3,
+                                         check_every=2)
+    assert n_p == n_r and res_p == res_r
+    _assert_bitexact(out_p, out_r)
+
+
+def test_pipeline_checked_runner_divergence_tracker():
+    """The sharded debug tracker (driver.make_checked_runner
+    use_checkify=False) threads the slab carry alongside its
+    (step, field) scalars and still reproduces the plain values."""
+    st, mesh, plain, pipe = _pair("heat3d", (32, 16, 128), (2, 1, 1), 4,
+                                  padfree=True, overlap=True)
+    f1 = shard_fields(init_state(st, (32, 16, 128), seed=5, kind="pulse"),
+                      mesh, 3)
+    runner = driver.make_checked_runner(pipe, 3, use_checkify=False)
+    out = runner(f1)
+    ref = _run_scanned(st, mesh, plain,
+                       init_state(st, (32, 16, 128), seed=5, kind="pulse"),
+                       3)
+    _assert_bitexact(out, ref)
+
+
+# ---------------------------------------------------------------------------
+# structure: one exchange round per iteration; two-sided independence
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("grid,mesh_shape,kind,padfree", [
+    ((32, 16, 128), (2, 1, 1), None, True),
+    pytest.param((32, 32, 128), (2, 2, 1), None, True,
+                 marks=pytest.mark.slow),
+    pytest.param((48, 32, 128), (2, 2, 1), "stream", None,
+                 marks=pytest.mark.slow),
+])
+def test_pipeline_body_structure(grid, mesh_shape, kind, padfree):
+    """The reusable helper (also run by scripts/tier1.sh): the body
+    holds exactly one exchange round, interior(i) is unreachable from
+    the ppermutes feeding pass i+1, and those ppermutes are unreachable
+    from interior(i)."""
+    st, mesh, plain, pipe = _pair("heat3d", grid, mesh_shape, 4,
+                                  kind=kind, padfree=padfree,
+                                  overlap=True)
+    fields = shard_fields(init_state(st, grid, seed=3, kind="pulse"),
+                          mesh, 3)
+    local = tuple(g // c for g, c in zip(grid, mesh_shape))
+    rep = assert_pipeline_body_structure(pipe, plain, fields, local,
+                                         overlap=True)
+    assert rep["interior_depends_on_exchange"] is False
+    assert rep["exchange_depends_on_interior"] is False
+
+
+def test_pipeline_nonoverlap_body_single_exchange_round():
+    """Without the overlap split there is no separate interior kernel,
+    but the one-round invariant still holds: the body's ppermute count
+    equals the plain step's."""
+    st, mesh, plain, pipe = _pair("heat3d", (32, 32, 128), (2, 2, 1), 4,
+                                  padfree=True, overlap=False)
+    fields = shard_fields(init_state(st, (32, 32, 128), seed=3,
+                                     kind="pulse"), mesh, 3)
+    slabs = jax.eval_shape(pipe._pipeline_prologue, fields)
+    n_body = count_primitive(
+        jax.make_jaxpr(pipe._pipeline_body)(fields, slabs), "ppermute")
+    n_plain = count_primitive(jax.make_jaxpr(plain)(fields), "ppermute")
+    assert n_body == n_plain > 0
+
+
+def test_pipeline_prologue_is_pure_exchange():
+    """The prologue must be the seed exchange only — no kernel runs
+    before the scan starts."""
+    st, mesh, plain, pipe = _pair("heat3d", (32, 16, 128), (2, 1, 1), 4,
+                                  padfree=True, overlap=True)
+    fields = shard_fields(init_state(st, (32, 16, 128), seed=3,
+                                     kind="pulse"), mesh, 3)
+    closed = jax.make_jaxpr(pipe._pipeline_prologue)(fields)
+    assert count_primitive(closed, "ppermute") > 0
+    assert count_primitive(closed, "pallas_call") == 0
+
+
+# ---------------------------------------------------------------------------
+# a requested pipeline never silently falls back
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_declines_periodic_with_reason():
+    st = make_stencil("heat3d")
+    mesh = make_mesh((2, 1, 1))
+    with pytest.raises(ValueError, match="guard-frame"):
+        make_sharded_fused_step(st, mesh, (32, 16, 128), 4,
+                                interpret=True, padfree=True,
+                                periodic=True, pipeline=True)
+
+
+def test_pipeline_declines_padded_kind_with_reason():
+    """An auto configuration that would take the exchange-padded kernel
+    (below the pad-free threshold, no forced kind) must raise, never
+    silently run the padded kernel under a pipeline request."""
+    st = make_stencil("heat3d")
+    mesh = make_mesh((2, 1, 1))
+    with pytest.raises(ValueError, match="slab-operand"):
+        make_sharded_fused_step(st, mesh, (32, 16, 128), 4,
+                                interpret=True, pipeline=True)
+    with pytest.raises(ValueError, match="slab-operand"):
+        make_sharded_fused_step(st, mesh, (32, 16, 128), 4,
+                                interpret=True, padfree=False,
+                                pipeline=True)
+
+
+def test_pipeline_declines_2d_with_reason():
+    st = make_stencil("life")
+    mesh = make_mesh((2,))
+    with pytest.raises(ValueError, match="3D-only"):
+        make_sharded_temporal_step(st, mesh, (64, 128), 8,
+                                   interpret=True, pipeline=True)
+
+
+def test_pipeline_untileable_returns_none_not_plain():
+    """Forced stream + pipeline on a geometry stream cannot tile: None
+    (cli raises), never a silently non-pipelined or non-stream step."""
+    st = make_stencil("heat3d")
+    mesh = make_mesh((2, 2, 1))
+    assert make_sharded_fused_step(st, mesh, (16, 32, 128), 4,
+                                   interpret=True, kind="stream",
+                                   pipeline=True) is None
+
+
+def test_pipeline_overlap_fallback_keeps_pipeline_active():
+    """local z = 8 < 3m: the overlap split declines (plain-overlap
+    contract), but the pipeline must STAY active on the non-split body —
+    the carry is still legal, only the shell/interior split is not."""
+    st = make_stencil("heat3d")
+    mesh = make_mesh((2, 1, 1))
+    grid = (16, 16, 128)
+    pipe = make_sharded_fused_step(st, mesh, grid, 4, interpret=True,
+                                   padfree=True, overlap=True,
+                                   pipeline=True)
+    assert pipe is not None
+    assert getattr(pipe, "_pipeline_active", False)
+    assert not getattr(pipe, "_overlap_active", False)
+    plain = make_sharded_fused_step(st, mesh, grid, 4, interpret=True,
+                                    padfree=True)
+    fields = init_state(st, grid, seed=9, kind="pulse")
+    _assert_bitexact(_run_scanned(st, mesh, pipe, fields, 3),
+                     _run_scanned(st, mesh, plain, fields, 3))
+
+
+def test_pipeline_plain_call_contract():
+    """Calling the pipelined stepper as a plain fields->fields function
+    (diagnostics, one-off steps) runs prologue + one body and matches
+    the non-pipelined step exactly."""
+    st, mesh, plain, pipe = _pair("heat3d", (32, 16, 128), (2, 1, 1), 4,
+                                  padfree=True, overlap=True)
+    fields = shard_fields(init_state(st, (32, 16, 128), seed=9,
+                                     kind="pulse"), mesh, 3)
+    _assert_bitexact(jax.jit(pipe)(fields), jax.jit(plain)(fields))
