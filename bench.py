@@ -111,6 +111,38 @@ def _last_real_measurement(cached=None):
         return None
 
 
+def _wedge_context():
+    """Heartbeat verdict + newest telemetry manifest for a wedged record.
+
+    The zero scoreboards of rounds 3-5 could not say WHY they were zero;
+    every wedged-path record now carries (a) a bounded backend probe
+    verdict from the framework's heartbeat (obs/heartbeat.py — WEDGED vs
+    NO_TPU vs in-process stall) and (b) the path of the newest telemetry
+    manifest on this box, so ``stale: true`` plus the why live in one
+    file.  NEVER raises (watchdog-thread safety); the probe is skipped
+    under ``BENCH_OBS_PROBE=0`` (tests — it spawns subprocesses).
+    """
+    out = {}
+    try:
+        if os.environ.get("BENCH_OBS_PROBE", "1") != "0":
+            from mpi_cuda_process_tpu.obs import heartbeat as _hb
+
+            verdict = _hb.probe_verdict(timeout_s=60.0)
+            out["heartbeat"] = {"verdict": verdict.get("verdict"),
+                                "detail": verdict.get("detail")}
+    except Exception:
+        pass
+    try:
+        from mpi_cuda_process_tpu.obs import trace as _tr
+
+        found = _tr.find_latest_manifest()
+        if found is not None:
+            out["telemetry_manifest"] = found[0]
+    except Exception:
+        pass
+    return out
+
+
 def _stale_fallback_record():
     """The watchdog's record when the backend is wedged.  NEVER raises —
     an exception here would kill the watchdog thread and leave the driver
@@ -158,6 +190,7 @@ def _stale_fallback_record():
             last = _last_real_measurement(cached)
             if last is not None:
                 rec["last_real_measurement"] = last
+            rec.update(_wedge_context())
             return rec
     except Exception:
         pass
@@ -170,6 +203,10 @@ def _stale_fallback_record():
     last = _last_real_measurement()
     if last is not None:
         rec["last_real_measurement"] = last
+    try:
+        rec.update(_wedge_context())
+    except Exception:
+        pass
     return rec
 
 
@@ -305,6 +342,33 @@ def _bench_safe(name, grid, steps, fuse):
         return bench_stencil(name, grid, {}, steps, fuse=0)
 
 
+def _write_bench_telemetry(rec, grid, steps, fuse, backend):
+    """Emit the round-gate's own telemetry manifest (obs/ schema).
+
+    One small JSONL under the shared telemetry dir: the same manifest
+    schema as ``cli --telemetry`` / measure.py / scaling.py, with the
+    headline record as its one result event — so the round-end bench is
+    itself provenance-stamped evidence, and the wedged-path
+    ``telemetry_manifest`` pointer has something local to point at.
+    Returns the path, or None (telemetry must never break the bench).
+    """
+    try:
+        from mpi_cuda_process_tpu.obs import trace as obs_trace
+
+        path = os.path.join(obs_trace.default_telemetry_dir(),
+                            "bench.jsonl")
+        with obs_trace.TraceWriter(path) as w:
+            w.write_manifest(obs_trace.build_manifest(
+                "bench",
+                {"grid": list(grid), "timed_steps": steps, "fuse": fuse,
+                 "backend": backend,
+                 "baseline_mcells": BASELINE_MCELLS}))
+            w.event("result", **rec)
+        return path
+    except Exception:
+        return None
+
+
 def main():
     backend = jax.default_backend()
     if backend == "cpu":
@@ -356,6 +420,9 @@ def main():
         rec["compute_512cubed"] = compute_lg
         if suspect_lg:
             rec["suspect_512cubed"] = True
+    tel = _write_bench_telemetry(rec, grid, steps, fuse, backend)
+    if tel:
+        rec["telemetry"] = tel
     if backend == "tpu" and not suspect and not rec.get("suspect_512cubed"):
         # Never seed the last-known-good cache with a noise-flagged record
         # (either grid size): the stale-fallback replay is the one path

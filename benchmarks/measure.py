@@ -774,6 +774,15 @@ def main():
                     help="print how many labels a plain run would still "
                          "execute, then exit (no backend contact — safe on "
                          "a wedged tunnel; used by watch_tunnel.sh)")
+    ap.add_argument("--telemetry", default=None, metavar="PATH",
+                    help="write a JSONL telemetry event log (obs/ "
+                         "schema, same manifest as cli --telemetry): "
+                         "one 'label' event per campaign config with "
+                         "its outcome, plus a stall-detecting heartbeat "
+                         "whose STALLED/WEDGED verdicts land in the "
+                         "log while a label is still hanging — the "
+                         "live view the wedge rounds never had.  "
+                         "Render with scripts/obs_report.py")
     args = ap.parse_args()
 
     if args.count_runnable:
@@ -801,6 +810,49 @@ def main():
               file=sys.stderr)
         return
 
+    session = None
+    if args.telemetry:
+        try:
+            from mpi_cuda_process_tpu import obs
+
+            session = obs.open_session(
+                args.telemetry, tool="measure",
+                run={"out": os.path.abspath(args.out),
+                     "only": args.only, "in_process": args.in_process,
+                     "builder_rev": BUILDER_REV,
+                     "n_configs": len(CONFIGS),
+                     "runnable": count_runnable(args.out)},
+                stall_after_s=420.0)
+            # NO backend probe on stall: a probe while a campaign child
+            # owns the tunnel is the two-process wedge hazard
+            # (docs/STATE.md) — the verdict records the stall, unprobed.
+            if session.heartbeat is not None:
+                session.heartbeat.probe = lambda: {
+                    "verdict": "SKIPPED",
+                    "detail": "no backend probe while a campaign label "
+                              "may own the tunnel (two-process wedge "
+                              "hazard)"}
+        except Exception as e:  # noqa: BLE001 — never block the campaign
+            print(f"[measure] telemetry disabled ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            session = None
+
+    def _tel_label(label, status=None, wall_s=None):
+        if session is None:
+            return
+        rec = _read_results(args.out).get(label) or {}
+        if status is None:
+            status = "error" if rec.get("error") else \
+                ("ok" if rec else "missing")
+        payload = {"label": label, "status": status,
+                   "compute": rec.get("compute"),
+                   "mcells_per_s": rec.get("mcells_per_s"),
+                   "error": (rec.get("error") or "")[:300] or None}
+        if wall_s is not None:
+            payload["wall_s"] = round(wall_s, 1)
+        session.event("label", **payload)
+
+    n_run = 0
     consecutive_timeouts = 0
     for label, name, grid, steps, dtype, compute in CONFIGS:
         if args.only and label not in args.only:
@@ -810,9 +862,13 @@ def main():
         # timeouts and declines.
         if not args.only and _skip_cached(results.get(label)):
             print(f"[measure] {label}: cached, skip", file=sys.stderr)
+            _tel_label(label, "cached")
             continue
+        n_run += 1
+        t_label = time.time()
         if args.in_process:
             _measure_one(args.out, label, name, grid, steps, dtype, compute)
+            _tel_label(label, wall_s=time.time() - t_label)
         else:
             # Subprocess + budget even under --only: the documented retry
             # path for recorded timeouts must not reintroduce an unbounded
@@ -839,6 +895,7 @@ def main():
                     print(f"[measure] {label}: subprocess rc={p.returncode}",
                           file=sys.stderr)
                 consecutive_timeouts = 0
+                _tel_label(label, wall_s=time.time() - t_label)
             except subprocess.TimeoutExpired:
                 # A hung config must cost only itself, not the campaign —
                 # and must not be silently retried by the next run (the
@@ -876,12 +933,17 @@ def main():
                     if not tunnel_ok:
                         rec["suspect"] = True
                     _merge_record(args.out, label, rec)
+                _tel_label(label, "timeout", wall_s=time.time() - t_label)
                 if not tunnel_ok:
                     # don't let the next label run into a wedged tunnel (a
                     # wedged-tunnel timeout would blame an innocent compile)
                     print("[measure] tunnel probe failed after the kill — "
                           "wedged; aborting campaign (rerun to resume)",
                           file=sys.stderr)
+                    if session is not None:
+                        session.event("abort",
+                                      reason="post-kill tunnel probe "
+                                             "failed — wedged")
                     break
                 consecutive_timeouts += 1
                 if consecutive_timeouts >= 2:
@@ -893,7 +955,16 @@ def main():
                     print("[measure] 2 consecutive timeouts despite "
                           "healthy probes — systemic; aborting campaign "
                           "(rerun to resume)", file=sys.stderr)
+                    if session is not None:
+                        session.event("abort",
+                                      reason="2 consecutive timeouts "
+                                             "despite healthy probes")
                     break
+
+    if session is not None:
+        session.finish(labels_run=n_run,
+                       runnable_after=count_runnable(args.out))
+        session.close()
 
     if not args.only and os.path.exists(args.out):
         with open(args.out) as fh:

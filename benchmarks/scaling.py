@@ -269,6 +269,13 @@ def main(argv=None) -> int:
                         "width-k exchange (weak/strong modes; meshes keep "
                         "the lane axis whole — untileable rungs are "
                         "skipped)")
+    p.add_argument("--telemetry", default=None, metavar="PATH",
+                   help="write a JSONL telemetry event log (obs/ "
+                        "schema, same manifest as cli --telemetry): "
+                        "one 'rung' event per emitted ladder row, "
+                        "'skip' events for declined rungs, heartbeat "
+                        "verdicts if a rung stalls.  Render with "
+                        "scripts/obs_report.py")
     p.add_argument("--mesh-axes", type=int, default=2, choices=[1, 2],
                    help="sharded-fused rung mesh arity (3D --fuse "
                         "ladders): 2 = balanced (z, y, 1) rungs "
@@ -293,11 +300,40 @@ def main(argv=None) -> int:
     # (never silently priced as plain rows).
 
     jax = _setup_devices(a.virtual)
-    from mpi_cuda_process_tpu.config import parse_int_tuple
     from mpi_cuda_process_tpu.ops.stencil import make_stencil
 
     st = make_stencil(a.stencil)
     n_devices = len(jax.devices())
+
+    session = None
+    if a.telemetry:
+        try:
+            from mpi_cuda_process_tpu import obs
+
+            session = obs.open_session(
+                a.telemetry, tool="scaling",
+                run={k: v for k, v in vars(a).items()},
+                stall_after_s=600.0)
+        except Exception as e:  # noqa: BLE001 — never block the harness
+            print(f"[scaling] telemetry disabled "
+                  f"({type(e).__name__}: {e})", file=sys.stderr)
+            session = None
+
+    def _tel(kind, **payload):
+        if session is not None:
+            session.event(kind, **payload)
+
+    try:
+        rc = _ladder(a, p, jax, st, n_devices, _tel)
+    finally:
+        if session is not None:
+            session.finish()
+            session.close()
+    return rc
+
+
+def _ladder(a, p, jax, st, n_devices, _tel) -> int:
+    from mpi_cuda_process_tpu.config import parse_int_tuple
 
     if a.mode == "halo":
         ladder = _mesh_ladder(n_devices, st.ndim)[1:]
@@ -309,14 +345,16 @@ def main(argv=None) -> int:
             t_full, t_local = bench_halo_overhead(
                 st, mesh_shape, global_shape, a.steps, a.reps)
             overhead = max(t_full - t_local, 0.0)
-            print(json.dumps({
+            rec = {
                 "mode": "halo", "stencil": a.stencil,
                 "mesh": list(mesh_shape), "grid": list(global_shape),
                 "ms_per_step_full": round(t_full * 1e3, 3),
                 "ms_per_step_no_exchange": round(t_local * 1e3, 3),
                 "halo_overhead_ms": round(overhead * 1e3, 3),
                 "halo_overhead_frac": round(overhead / t_full, 4),
-            }))
+            }
+            print(json.dumps(rec))
+            _tel("rung", **rec)
         return 0
 
     base = None
@@ -354,6 +392,10 @@ def main(argv=None) -> int:
                   f"k={a.fuse}"
                   + (" (or cannot host --pipeline)" if a.pipeline
                      else ""), file=sys.stderr)
+            _tel("skip", mesh=list(mesh_shape), grid=list(global_shape),
+                 fuse=a.fuse, pipeline=a.pipeline,
+                 reason="untileable or cannot host the requested "
+                        "overlap/pipeline/kind contract")
             continue
         mcells, per_step, kernel_kind = got
         per_dev = mcells / n_dev
@@ -376,6 +418,7 @@ def main(argv=None) -> int:
             "ms_per_step": round(per_step * 1e3, 3),
         }
         print(json.dumps(rec))
+        _tel("rung", **rec)
 
     print(f"\n{a.mode} scaling — {a.stencil}"
           f" ({n_devices} devices, {jax.default_backend()})", file=sys.stderr)

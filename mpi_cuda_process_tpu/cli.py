@@ -76,6 +76,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ASCII-render the final grid")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace for the run")
+    p.add_argument("--telemetry", default=None, metavar="PATH",
+                   help="write a JSONL telemetry event log: a "
+                        "provenance-stamped run manifest (config, mesh, "
+                        "git sha, backend, jax version — one schema "
+                        "shared with bench.py and the benchmark "
+                        "harnesses), per-chunk runtime stats (compile "
+                        "vs steady-state, recompile detection, device "
+                        "memory peaks), static cost counters with a "
+                        "roofline prediction (flops, HBM bytes, "
+                        "ppermute rounds/bytes, cross-checked against "
+                        "the --mem-check budget model), and a stall-"
+                        "detecting heartbeat (STALLED/WEDGED verdicts). "
+                        "Recorded only at chunk boundaries — zero ops "
+                        "inside the jitted step.  Render with "
+                        "scripts/obs_report.py PATH")
     p.add_argument("--overlap", action="store_true",
                    help="explicit interior/boundary split so the halo "
                         "exchange overlaps bulk compute (vs trusting XLA); "
@@ -168,6 +183,7 @@ def config_from_args(argv=None) -> RunConfig:
         checkpoint_every=a.checkpoint_every, checkpoint_dir=a.checkpoint_dir,
         checkpoint_backend=a.checkpoint_backend,
         resume=a.resume, render=a.render, profile_dir=a.profile_dir,
+        telemetry=a.telemetry,
         compute=a.compute, overlap=a.overlap, pipeline=a.pipeline,
         ensemble=a.ensemble,
         fuse=a.fuse, fuse_kind=a.fuse_kind,
@@ -630,7 +646,13 @@ def run(cfg: RunConfig) -> Tuple:
         log.warning(
             "auto-selected Pallas path failed (%s); retrying this run on "
             "the jnp path", first)
-        return _run_once(dataclasses.replace(cfg, compute="jnp"))
+        retry_cfg = dataclasses.replace(cfg, compute="jnp")
+        if cfg.telemetry:
+            # keep the failed run's trace (it recorded the error event);
+            # the retry writes its own log next to it
+            retry_cfg = dataclasses.replace(
+                retry_cfg, telemetry=cfg.telemetry + ".retry.jsonl")
+        return _run_once(retry_cfg)
 
 
 def _looks_like_pallas_failure(e: BaseException) -> bool:
@@ -683,16 +705,62 @@ def _check_mem_budget(cfg: RunConfig) -> None:
         log.debug("HBM budget: ~%.2f GiB/device estimated", total / 2**30)
 
 
+def _open_telemetry(cfg: RunConfig):
+    """Telemetry session for ``--telemetry PATH`` (obs/), or None.
+
+    The manifest is written up front (a run that dies mid-compile still
+    leaves its provenance), the heartbeat starts immediately, and the
+    recorder becomes ``run_simulation``'s chunk-boundary observer.
+    """
+    from . import obs
+
+    return obs.open_session(
+        cfg.telemetry, tool="cli", run=dataclasses.asdict(cfg),
+        step_unit=max(1, cfg.fuse))
+
+
+def _emit_static_cost(cfg: RunConfig, st, session) -> None:
+    """Best-effort static cost counters + roofline into the trace."""
+    try:
+        from .obs import costmodel
+
+        session.event("costmodel", **costmodel.static_cost(
+            st, cfg.grid, mesh=cfg.mesh, fuse=cfg.fuse,
+            fuse_kind=cfg.fuse_kind, periodic=cfg.periodic,
+            ensemble=cfg.ensemble))
+    except Exception:  # noqa: BLE001 — telemetry is never load-bearing
+        log.debug("static cost model failed; trace goes without it",
+                  exc_info=True)
+
+
 def _run_once(cfg: RunConfig) -> Tuple:
+    if not cfg.telemetry:
+        return _run_measured(cfg, None)
+    session = _open_telemetry(cfg)
+    try:
+        return _run_measured(cfg, session)
+    except BaseException as e:
+        session.error(e)
+        raise
+    finally:
+        session.close()
+
+
+def _run_measured(cfg: RunConfig, session) -> Tuple:
     if cfg.debug_checks and cfg.fuse:
         raise ValueError("--debug-checks excludes --fuse (the fused "
                          "kernel replaces the step being instrumented)")
     _check_mem_budget(cfg)
     mesh_lib.bootstrap_distributed()
     st, step_fn, fields, start_step = build(cfg)
+    if session is not None:
+        _emit_static_cost(cfg, st, session)
     remaining = cfg.iters - start_step
     if remaining <= 0:
         log.info("checkpoint already at step %d >= iters", start_step)
+        if session is not None:
+            session.finish(steps=0, mcells_per_s=0.0,
+                           note="checkpoint already at/past iters")
         return fields, 0.0
 
     cells = math.prod(cfg.grid) * max(1, cfg.ensemble)
@@ -734,6 +802,13 @@ def _run_once(cfg: RunConfig) -> Tuple:
             "converged=%s after %d steps (residual %.3e, tol %.1e) in %.3fs"
             "  (%.1f Mcells/s)",
             res <= cfg.tol, n_done, res, cfg.tol, dt, mcells)
+        if session is not None:
+            # one while_loop = one chunk (compile + run, inseparable here)
+            session.recorder.record_chunk(n_calls, dt)
+            session.finish(phase="tol_loop", steps=n_done, wall_s=dt,
+                           mcells_per_s=round(mcells, 3),
+                           converged=bool(res <= cfg.tol),
+                           residual=float(res))
         _epilogue(cfg, fields, start_step + n_done, save_ckpt=True)
         return fields, mcells
 
@@ -806,7 +881,8 @@ def _run_once(cfg: RunConfig) -> Tuple:
             st, fields, remaining // step_unit, step_fn=step_fn,
             log_every=interval, callback=callback,
             start_step=start_step // step_unit,
-            runner_factory=runner_factory)
+            runner_factory=runner_factory,
+            observer=session.recorder if session is not None else None)
         fields = jax.block_until_ready(fields)
     dt = time.perf_counter() - t0
     if cfg.dump_every and cfg.dump_dir:
@@ -815,6 +891,11 @@ def _run_once(cfg: RunConfig) -> Tuple:
 
     log.info("%d steps on %s grid in %.3fs  (%.1f Mcells/s)",
              remaining, "x".join(map(str, cfg.grid)), dt, mcells)
+    if session is not None:
+        # 3 decimals: a CPU smoke run's honest fraction of an Mcell/s
+        # must not round to a zero that reads as "no throughput"
+        session.finish(steps=remaining, wall_s=round(dt, 4),
+                       mcells_per_s=round(mcells, 3))
     _epilogue(cfg, fields, cfg.iters, save_ckpt=bool(cfg.checkpoint_every))
     return fields, mcells
 

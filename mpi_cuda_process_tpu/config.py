@@ -51,6 +51,9 @@ class RunConfig:
     tol_check_every: int = 10  # residual check cadence for --tol
     dump_every: int = 0  # >0: async .npy snapshots of field0 every N steps
     dump_dir: Optional[str] = None
+    # JSONL telemetry event log (obs/): run manifest + per-chunk runtime
+    # stats + static cost counters + heartbeat verdicts; None = no trace
+    telemetry: Optional[str] = None
     mem_check: str = "error"  # error | warn | off: per-device HBM budget guard
     params: Dict[str, Any] = dataclasses.field(default_factory=dict)
 

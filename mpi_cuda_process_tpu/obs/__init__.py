@@ -1,0 +1,122 @@
+"""Unified telemetry layer: manifests, chunk stats, cost counters, heartbeat.
+
+One subsystem shared by every entry point — ``cli --telemetry PATH``,
+``bench.py``, ``benchmarks/measure.py``, ``benchmarks/scaling.py`` —
+so all four emit the SAME versioned manifest schema (``trace.py``'s
+validator is the single definition) and the same event vocabulary:
+
+* ``manifest``   — provenance-stamped run record (first line, always)
+* ``costmodel``  — static flop/HBM/ppermute counters + roofline
+* ``chunk``      — per-chunk wall time, recompile flag, memory peaks
+* ``heartbeat``  — STALLED/WEDGED/RECOVERED verdicts from the watcher
+* ``label`` / ``rung`` — benchmark-harness progress records
+* ``error`` / ``summary`` — how the run ended
+
+:func:`open_session` is the one-call wiring: trace writer + manifest +
+runtime recorder + heartbeat, bundled in a :class:`Session`.  Telemetry
+is an observer, never load-bearing: events record only at chunk/label
+boundaries (the jitted step is untouched — pinned by jaxpr inspection
+in tests), and callers guard session setup so a telemetry failure
+cannot kill the run it watches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from . import heartbeat as heartbeat_lib
+from . import runtime as runtime_lib
+from . import trace as trace_lib
+
+
+class Session:
+    """A live telemetry session: trace + recorder + optional heartbeat.
+
+    ``recorder`` is the driver-facing observer
+    (``record_chunk(steps, seconds)`` at chunk boundaries);
+    ``event``/``finish``/``error`` write to the trace.  ``finish`` and
+    ``close`` are idempotent, and ``close`` always stops the heartbeat
+    first so no verdict thread outlives its run.
+    """
+
+    def __init__(self, trace: trace_lib.TraceWriter,
+                 recorder: runtime_lib.RuntimeRecorder,
+                 heartbeat: Optional[heartbeat_lib.Heartbeat]):
+        self.trace = trace
+        self.recorder = recorder
+        self.heartbeat = heartbeat
+        self._finished = False
+
+    @property
+    def path(self) -> str:
+        return self.trace.path
+
+    def event(self, kind: str, **payload: Any) -> None:
+        self.trace.event(kind, **payload)
+        self.recorder.mark()
+
+    def progress(self) -> None:
+        """Liveness tick without an event (harness inner loops)."""
+        self.recorder.mark()
+
+    def finish(self, **payload: Any) -> None:
+        """Write the summary event (once): runtime stats + caller extras."""
+        if self._finished:
+            return
+        self._finished = True
+        hb = (self.heartbeat.last_verdict if self.heartbeat is not None
+              else None)
+        self.trace.event("summary", runtime=self.recorder.summary(),
+                         heartbeat=hb, **payload)
+
+    def error(self, exc: BaseException) -> None:
+        try:
+            self.trace.event(
+                "error", error=f"{type(exc).__name__}: {exc}"[:1200],
+                runtime=self.recorder.summary())
+        except Exception:  # noqa: BLE001 — already failing; don't mask
+            pass
+
+    def close(self) -> None:
+        if self.heartbeat is not None:
+            self.heartbeat.stop()
+        self.trace.close()
+
+    def __enter__(self) -> "Session":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.error(exc)
+        else:
+            self.finish()
+        self.close()
+
+
+def open_session(
+    path: str,
+    tool: str,
+    run: Dict[str, Any],
+    step_unit: int = 1,
+    stall_after_s: float = 600.0,
+    with_heartbeat: bool = True,
+    **manifest_extra: Any,
+) -> Session:
+    """Open a trace at ``path``, write the manifest, start the heartbeat.
+
+    The shared constructor all four tools call — the mechanism by which
+    "same schema" is a property of the code rather than a convention.
+    """
+    trace = trace_lib.TraceWriter(path)
+    trace.write_manifest(trace_lib.build_manifest(
+        tool, run, **manifest_extra))
+    recorder = runtime_lib.RuntimeRecorder(trace=trace, step_unit=step_unit)
+    hb = None
+    if with_heartbeat:
+        hb = heartbeat_lib.Heartbeat(recorder, trace=trace,
+                                     stall_after_s=stall_after_s)
+        hb.start()
+    return Session(trace, recorder, hb)
+
+
+__all__ = ["Session", "open_session"]

@@ -1,0 +1,341 @@
+"""Static per-step cost counters: flops, HBM bytes, ppermute rounds/bytes.
+
+Every telemetry manifest carries a roofline prediction next to its
+measurement (the attribution discipline of the TPU CFD framework,
+arXiv:2108.11076 §5, and the MPMD overlap-accounting of
+arXiv:2412.14374): ``scripts/obs_report.py`` renders predicted vs
+measured per phase, so a run that is slower than its own static model
+says WHERE (interior bandwidth, exchange, compile).
+
+Two kinds of counter, deliberately separate:
+
+* **jaxpr extraction** (:func:`flops_from_jaxpr`,
+  :func:`comm_stats_from_jaxpr`): counts read off a traced program —
+  exact for the program traced, usable wherever tracing is possible
+  (tests trace small sharded steps on virtual devices).
+* **analytic model** (:func:`comm_stats`, :func:`hbm_bytes_per_step`):
+  closed-form counts for configurations whose device population does
+  not exist on this box (config 5's 64-chip meshes).  The analytic
+  exchange model is CROSS-CHECKED two ways: against the jaxpr counts on
+  traceable configs, and against ``utils/budget.py``'s byte-pinned slab
+  accounting (:func:`budget_crosscheck`) — tests pin both to the byte,
+  so the three models (jaxpr reality, this module, the HBM budget)
+  cannot drift apart silently.
+
+Nothing here executes device code: tracing is shape-level, the analytic
+paths are pure arithmetic.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.jaxprcheck import iter_jaxprs
+
+# v5e anchors (docs/STATE.md): HBM peak per chip; ICI per link.  The
+# measured Mosaic DMA envelope (~330 GB/s) is reported alongside, not
+# substituted — the roofline is an upper bound, not a fit.
+V5E_HBM_GBS = 819.0
+V5E_ICI_GBS = 45.0
+
+# Elementwise primitives counted as one flop per output element.  A
+# MODEL, not a lowering simulator: comparisons, selects, copies, pads,
+# and layout ops are free; transcendentals count 1 (they dominate no
+# stencil here).  The counter's job is a stable, pinned, comparable
+# number per program — tests assert exact values so drift is loud.
+_FLOP_PRIMS = frozenset({
+    "add", "add_any", "sub", "mul", "div", "rem", "pow", "integer_pow",
+    "max", "min", "neg", "abs", "exp", "log", "tanh", "sqrt", "rsqrt",
+    "sign", "floor", "ceil", "round", "erf", "logistic", "sin", "cos",
+})
+
+
+def flops_from_jaxpr(closed) -> int:
+    """Weighted elementwise-arithmetic count across all nested jaxprs.
+
+    Counts each eqn once (do not feed scanned/looped programs unless
+    one iteration is what you mean to count).
+    """
+    total = 0
+    for jx in iter_jaxprs(closed.jaxpr):
+        for eqn in jx.eqns:
+            if eqn.primitive.name in _FLOP_PRIMS:
+                total += max(
+                    (math.prod(ov.aval.shape) for ov in eqn.outvars),
+                    default=0)
+    return total
+
+
+def comm_stats_from_jaxpr(closed) -> Dict[str, int]:
+    """ppermute rounds and per-device bytes read off a traced program.
+
+    Each ``ppermute`` eqn is one exchange round; its operand aval is
+    what every participating device sends (and receives) — summing aval
+    bytes gives the per-device ICI payload per call of the traced
+    function.
+    """
+    rounds = 0
+    bytes_ = 0
+    for jx in iter_jaxprs(closed.jaxpr):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "ppermute":
+                rounds += 1
+                aval = eqn.invars[0].aval
+                bytes_ += math.prod(aval.shape) * aval.dtype.itemsize
+    return {"ppermute_rounds": rounds, "ppermute_bytes": bytes_}
+
+
+def step_flops(stencil, shape: Sequence[int], periodic: bool = False) -> int:
+    """Flops of ONE reference jnp step on ``shape`` (trace-only).
+
+    The counter of record for every execution strategy: the fused/raw
+    kernels compute the same update (plus margin redundancy the model
+    deliberately ignores), so one number is comparable across paths.
+    """
+    from ..driver import make_step
+
+    step = make_step(stencil, tuple(int(s) for s in shape),
+                     periodic=periodic)
+    abstract = tuple(
+        jax.ShapeDtypeStruct(tuple(int(s) for s in shape), stencil.dtype)
+        for _ in range(stencil.num_fields))
+    return flops_from_jaxpr(jax.make_jaxpr(step)(abstract))
+
+
+def _local_shape(grid: Sequence[int],
+                 mesh: Sequence[int]) -> Tuple[int, ...]:
+    counts = tuple(mesh) + (1,) * (len(grid) - len(mesh)) if mesh else \
+        (1,) * len(grid)
+    return tuple(int(g) // int(c) for g, c in zip(grid, counts))
+
+
+def hbm_bytes_per_step(stencil, local_shape: Sequence[int],
+                       fuse: int = 0, batch: int = 1) -> int:
+    """Minimum per-device HBM traffic per REAL step: one read + one
+    write of every field, divided by the temporal-blocking depth (k
+    steps per HBM pass is exactly what ``--fuse`` buys)."""
+    cells = max(1, int(batch)) * math.prod(int(s) for s in local_shape)
+    item = jnp.dtype(stencil.dtype).itemsize
+    return (2 * stencil.num_fields * cells * item) // max(1, int(fuse))
+
+
+def comm_stats(
+    stencil,
+    grid: Sequence[int],
+    mesh: Sequence[int] = (),
+    fuse: int = 0,
+    fuse_kind: str = "auto",
+    periodic: bool = False,
+) -> Optional[Dict[str, Any]]:
+    """Analytic ppermute rounds + bytes per device, or None (unsharded).
+
+    Mirrors the exchange the steppers actually issue (pinned against
+    traced jaxprs in tests/test_obs.py):
+
+    * slab-operand fused kinds (``padfree``/``stream``): width-``m``
+      face slabs per field — z-only meshes 2 rounds/field of
+      ``(m, ly, lx)``; meshes that shard y add 2 y-rounds of
+      ``(lz, m, lx)`` and 4 two-pass corner rounds of ``(m, m, lx)``
+      (``halo.exchange_slabs_2axis``).  ``slab_operand_bytes`` prices
+      the kernel's operand STORAGE for the same set (2-axis kernels
+      duplicate/align the y-facing operands) and must equal
+      ``utils/budget.py``'s slab part to the byte.
+    * padded fused kind / plain jnp step: the two-pass
+      ``exchange_and_pad`` scheme — axis d's slabs span axes < d
+      already padded; the plain step exchanges only fields with a
+      nonzero ``field_halo`` at width ``halo``, the fused kinds every
+      field at width ``m``.
+    """
+    ndim = stencil.ndim
+    counts = (tuple(int(c) for c in mesh) + (1,) * ndim)[:ndim]
+    if math.prod(counts) <= 1:
+        return None
+    local = _local_shape(grid, mesh)
+    item = jnp.dtype(stencil.dtype).itemsize
+    nf = stencil.num_fields
+
+    if fuse:
+        from ..ops.pallas.fused import _halo_per_micro
+
+        m = int(fuse) * _halo_per_micro(stencil)
+        widths = (m,) * nf
+        per_pass_steps = int(fuse)
+    else:
+        widths = tuple(stencil.field_halos)
+        per_pass_steps = 1
+
+    # slab-operand kinds exist for 3D only (2D fused runs use the
+    # whole-local-block kernel behind the padded-style exchange)
+    kind = fuse_kind if (fuse and ndim == 3
+                         and fuse_kind in ("padfree", "stream")) \
+        else ("padded" if fuse else "plain")
+
+    rounds = 0
+    ici = 0
+    operand: Optional[int] = None
+    if kind in ("padfree", "stream"):
+        lz, ly, lx = local
+        m = widths[0]
+        two_axis = counts[1] > 1
+        z_sharded = counts[0] > 1
+        z_bytes = m * ly * lx * item
+        if z_sharded:
+            rounds += nf * 2
+            ici += nf * 2 * z_bytes
+        if two_axis:
+            y_bytes = lz * m * lx * item
+            c_bytes = m * m * lx * item
+            rounds += nf * (2 + 4)
+            ici += nf * (2 * y_bytes + 4 * c_bytes)
+            # operand storage: the 2-axis kernels carry the y-facing
+            # operands duplicated (pad-free: 2m rows) or sublane-aligned
+            # (stream: m + m_a) — exactly budget.py's slab accounting
+            from ..ops.pallas.fused import _sublane
+
+            if kind == "stream":
+                m_a = -(-m // _sublane(item)) * _sublane(item)
+                dup = m + m_a
+            else:
+                dup = 2 * m
+            operand = nf * item * (2 * m * ly * lx
+                                   + 2 * dup * lz * lx
+                                   + 4 * m * dup * lx)
+        else:
+            operand = nf * 2 * z_bytes
+    else:
+        # two-pass exchange_and_pad: axis d exchanged after axes < d are
+        # padded, so its slab spans the already-grown extents
+        for i in range(nf):
+            w = widths[i]
+            if not w:
+                continue
+            for d in range(ndim):
+                if counts[d] <= 1:
+                    continue
+                slab_cells = w
+                for j in range(ndim):
+                    if j == d:
+                        continue
+                    slab_cells *= local[j] + (2 * w if j < d else 0)
+                rounds += 2
+                ici += 2 * slab_cells * item
+
+    return {
+        "kind": kind,
+        "per_pass_steps": per_pass_steps,
+        "width_m": max(widths),
+        "sharded_counts": list(counts),
+        "ppermute_rounds_per_pass": rounds,
+        "ici_bytes_per_pass": ici,
+        "ici_bytes_per_step": ici / per_pass_steps,
+        "slab_operand_bytes": operand,
+    }
+
+
+def budget_crosscheck(
+    stencil,
+    grid: Sequence[int],
+    mesh: Sequence[int],
+    fuse: int,
+    fuse_kind: str,
+    periodic: bool = False,
+) -> Optional[Dict[str, Any]]:
+    """Assert-by-record: this module's slab-operand bytes vs budget.py's.
+
+    Returns ``{"slab_operand_bytes", "budget_bytes", "match"}`` for the
+    slab-operand kinds, None where budget has no slab part to compare.
+    The pair rides the manifest so a drift between the two byte models
+    is visible in every event log, and tests pin ``match == True`` for
+    config 5 on both mesh families.
+    """
+    cs = comm_stats(stencil, grid, mesh, fuse=fuse, fuse_kind=fuse_kind,
+                    periodic=periodic)
+    if cs is None or cs.get("slab_operand_bytes") is None:
+        return None
+    from ..utils import budget
+
+    _, parts = budget.estimate_run_bytes(
+        stencil, grid, mesh=mesh, fuse=fuse, fuse_kind=fuse_kind,
+        periodic=periodic)
+    slab = [b for label, b in parts
+            if "operands only" in label and b > 0]
+    if not slab:
+        return None
+    return {
+        "slab_operand_bytes": cs["slab_operand_bytes"],
+        "budget_bytes": slab[0],
+        "match": cs["slab_operand_bytes"] == slab[0],
+    }
+
+
+def static_cost(
+    stencil,
+    grid: Sequence[int],
+    mesh: Sequence[int] = (),
+    fuse: int = 0,
+    fuse_kind: str = "auto",
+    periodic: bool = False,
+    ensemble: int = 0,
+    hbm_gbs: float = V5E_HBM_GBS,
+    ici_gbs: float = V5E_ICI_GBS,
+) -> Dict[str, Any]:
+    """The manifest's static cost block: counters + roofline prediction.
+
+    Per-device flops (jaxpr-counted on the local block), minimum HBM
+    traffic per step, the exchange model, the budget cross-check, and
+    two throughput predictions: ``overlapped`` prices the paper's core
+    claim (exchange hidden behind interior compute — step time is the
+    HBM bound alone) and ``serial`` the unhidden schedule; the measured
+    number landing between them is the overlap win, quantified.
+    """
+    grid = tuple(int(g) for g in grid)
+    local = _local_shape(grid, mesh)
+    batch = max(1, int(ensemble))
+    comm = comm_stats(stencil, grid, mesh, fuse=fuse, fuse_kind=fuse_kind,
+                      periodic=periodic)
+    flops = batch * step_flops(stencil, local, periodic=periodic)
+    hbm_b = hbm_bytes_per_step(stencil, local, fuse=fuse, batch=batch)
+    t_hbm_ms = hbm_b / (hbm_gbs * 1e9) * 1e3
+    t_ici_ms = (comm["ici_bytes_per_step"] / (ici_gbs * 1e9) * 1e3
+                if comm else 0.0)
+    cells = batch * math.prod(grid)
+
+    def _mcells(t_ms: float) -> float:
+        return cells / (t_ms * 1e-3) / 1e6 if t_ms > 0 else float("inf")
+
+    out: Dict[str, Any] = {
+        "grid": list(grid),
+        "mesh": list(mesh),
+        "local_shape": list(local),
+        "batch": batch,
+        "fuse": int(fuse),
+        "fuse_kind": comm["kind"] if comm else (fuse_kind if fuse else None),
+        "dtype": str(jnp.dtype(stencil.dtype)),
+        "flops_per_step_per_device": int(flops),
+        "hbm_bytes_per_step_per_device": int(hbm_b),
+        "comm": comm,
+        "roofline": {
+            "hbm_gbs": hbm_gbs,
+            "ici_gbs": ici_gbs,
+            "predicted_ms_per_step_hbm": round(t_hbm_ms, 6),
+            "predicted_ms_per_step_exchange": round(t_ici_ms, 6),
+            "predicted_mcells_per_s_overlapped": round(
+                _mcells(t_hbm_ms), 1),
+            "predicted_mcells_per_s_serial": round(
+                _mcells(t_hbm_ms + t_ici_ms), 1),
+            "basis": "minimum HBM traffic at peak bandwidth; 'overlapped'"
+                     " assumes the exchange fully hidden (the paper's "
+                     "claim), 'serial' adds it to the critical path",
+        },
+    }
+    if comm and comm.get("slab_operand_bytes") is not None:
+        try:
+            out["budget_crosscheck"] = budget_crosscheck(
+                stencil, grid, mesh, fuse, fuse_kind, periodic=periodic)
+        except Exception:  # noqa: BLE001 — the cross-check must never
+            out["budget_crosscheck"] = None  # block a manifest write
+    return out

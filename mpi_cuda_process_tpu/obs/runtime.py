@@ -1,0 +1,178 @@
+"""Per-chunk runtime stats: compile vs steady state, recorded host-side.
+
+``driver.run_simulation`` calls :meth:`RuntimeRecorder.record_chunk`
+once per chunk boundary with the chunk's wall time (measured around the
+already-materializing runner call).  Everything here is host Python —
+no jax primitive, no callback, no extra op inside the jitted
+``lax.scan`` (tests/test_obs.py pins the step jaxpr byte-identical with
+and without a recorder attached).  The cost of observation is one
+``block_until_ready`` per chunk boundary, where the driver's callback
+was about to materialize state anyway.
+
+What a chunk record carries:
+
+* wall seconds and ms/step (in REAL steps: the recorder knows the
+  ``--fuse`` step unit);
+* a recompile flag — ``jax.monitoring``'s backend-compile events are
+  counted process-wide, so a chunk that triggered a compile AFTER the
+  first chunk (shape drift, cache invalidation, a second chunk size)
+  is marked instead of silently polluting the steady-state percentiles;
+* ``device.memory_stats()`` peaks when the backend reports them (TPU
+  does; CPU returns None and the field is omitted).
+
+:meth:`summary` separates the first chunk (compile + warmup) from the
+steady tail and reports p50/p90/best ms/step — the numbers
+``scripts/obs_report.py`` renders next to the static cost model's
+roofline prediction.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+
+# Process-wide compile counter via jax.monitoring.  Registration is
+# one-way (jax offers no targeted unregister), so one module-level
+# listener serves every recorder; each recorder diffs the counter.
+_COMPILE_EVENT_SUFFIX = "backend_compile_duration"
+_compile_events = [0]
+_listener_on = [False]
+
+
+def _on_duration(event: str, duration: float, **_kw: Any) -> None:
+    if event.endswith(_COMPILE_EVENT_SUFFIX):
+        _compile_events[0] += 1
+
+
+def _ensure_compile_listener() -> None:
+    if _listener_on[0]:
+        return
+    try:
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        _listener_on[0] = True
+    except Exception:  # noqa: BLE001 — recompile detection is best-effort
+        pass
+
+
+def compile_events_seen() -> int:
+    """Backend compiles observed in this process (0 if unavailable)."""
+    return _compile_events[0]
+
+
+def device_memory_stats() -> Dict[str, int]:
+    """Whitelisted ``memory_stats()`` of device 0, or {} when unreported."""
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001
+        return {}
+    if not stats:
+        return {}
+    return {k: int(stats[k])
+            for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+            if k in stats}
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class RuntimeRecorder:
+    """Collects per-chunk wall times; optionally mirrors them to a trace.
+
+    ``step_unit`` converts the driver's call-unit chunk sizes into real
+    steps (``--fuse K`` advances K steps per call).  ``last_progress``
+    (monotonic seconds) is the liveness signal the heartbeat watches.
+    """
+
+    def __init__(self, trace=None, step_unit: int = 1):
+        self.trace = trace
+        self.step_unit = max(1, int(step_unit))
+        self.chunks: List[Dict[str, Any]] = []
+        self.recompiles = 0
+        self.last_progress = time.monotonic()
+        self._chunk_begin_compiles: Optional[int] = None
+        _ensure_compile_listener()
+
+    def mark(self) -> None:
+        """Record liveness without a chunk (benchmark harness loops)."""
+        self.last_progress = time.monotonic()
+
+    def begin_chunk(self) -> None:
+        """Snapshot the compile counter as a chunk starts.
+
+        Compiles landing BETWEEN chunks (the logging callback tracing
+        its diagnostics reductions, a checkpoint save) are legitimate
+        and must not read as hot-loop recompiles; only compiles between
+        ``begin_chunk`` and ``record_chunk`` implicate the scan itself.
+        """
+        self.mark()
+        self._chunk_begin_compiles = compile_events_seen()
+
+    def record_chunk(self, steps: int, seconds: float) -> Dict[str, Any]:
+        """One chunk finished: ``steps`` call-units in ``seconds`` wall.
+
+        The ONLY driver-facing entry point (with :meth:`begin_chunk`);
+        called strictly at chunk boundaries, never from traced code.
+        """
+        self.mark()
+        real_steps = int(steps) * self.step_unit
+        n = len(self.chunks)
+        recompiled = False
+        if self._chunk_begin_compiles is not None:
+            during = compile_events_seen() - self._chunk_begin_compiles
+            self._chunk_begin_compiles = None
+            # first chunk: compiles are the expected warmup, not drift
+            if n > 0 and during > 0:
+                recompiled = True
+                self.recompiles += during
+        rec: Dict[str, Any] = {
+            "chunk": n,
+            "steps": real_steps,
+            "wall_s": round(float(seconds), 6),
+            "ms_per_step": round(seconds * 1e3 / max(1, real_steps), 6),
+            "recompiled": recompiled,
+        }
+        mem = device_memory_stats()
+        if mem:
+            rec["memory"] = mem
+        self.chunks.append(rec)
+        if self.trace is not None:
+            self.trace.event("chunk", **rec)
+        return rec
+
+    def summary(self) -> Dict[str, Any]:
+        """Compile-separated aggregate: first chunk vs steady percentiles."""
+        out: Dict[str, Any] = {
+            "n_chunks": len(self.chunks),
+            "steps": sum(c["steps"] for c in self.chunks),
+            "recompiles": self.recompiles,
+        }
+        if not self.chunks:
+            return out
+        out["first_chunk_s"] = self.chunks[0]["wall_s"]
+        out["first_chunk_ms_per_step"] = self.chunks[0]["ms_per_step"]
+        # steady state = everything after the compile+warmup chunk; a
+        # single-chunk run has no steady sample and says so rather than
+        # passing compile time off as throughput
+        steady = [c for c in self.chunks[1:] if not c["recompiled"]]
+        if steady:
+            per = sorted(c["ms_per_step"] for c in steady)
+            out["steady"] = {
+                "chunks": len(per),
+                "ms_per_step_best": per[0],
+                "ms_per_step_p50": _percentile(per, 0.50),
+                "ms_per_step_p90": _percentile(per, 0.90),
+            }
+        peaks = [c["memory"].get("peak_bytes_in_use")
+                 for c in self.chunks if "memory" in c]
+        peaks = [p for p in peaks if p is not None]
+        if peaks:
+            out["memory_peak_bytes"] = max(peaks)
+        return out

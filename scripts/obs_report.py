@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""Render a telemetry event log into a per-phase attribution report.
+
+Reads a JSONL trace written by the obs/ layer (``cli --telemetry``,
+bench.py, benchmarks/measure.py, benchmarks/scaling.py — one shared
+manifest schema) and prints:
+
+* the manifest (what ran, where, from which code);
+* the static cost model next to the measurement — a per-phase table
+  attributing the step budget to interior HBM traffic, the ppermute
+  exchange, and the boundary shells, with the roofline's ``overlapped``
+  vs ``serial`` predictions bracketing the measured steady-state
+  ms/step (the measured number landing between them IS the overlap win,
+  quantified — the attribution discipline of arXiv:2108.11076);
+* runtime stats (compile vs steady chunks, recompiles, memory peaks),
+  heartbeat verdicts, benchmark label/rung records, and how the run
+  ended.
+
+``--check`` validates the log against the shared schema and exits
+nonzero on any invalid record — the mode ``scripts/tier1.sh`` runs, so
+a tool drifting off-schema fails the gate.
+
+Safe on a wedged box: the CPU backend is forced before any jax use and
+nothing here touches a device.
+
+Usage:  python scripts/obs_report.py PATH [--check]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from cpuforce import force_cpu  # noqa: E402
+
+force_cpu()  # before the package (and hence any jax backend) loads
+
+from mpi_cuda_process_tpu.obs import trace as obs_trace  # noqa: E402
+
+
+def _fmt_bytes(b) -> str:
+    if b is None:
+        return "-"
+    if b >= 2**30:
+        return f"{b / 2**30:.2f} GiB"
+    if b >= 2**20:
+        return f"{b / 2**20:.2f} MiB"
+    return f"{b} B"
+
+
+def _table(rows, header):
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    out = ["  ".join(str(h).ljust(w) for h, w in zip(header, widths))]
+    out.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def _manifest_block(m) -> str:
+    p = m["provenance"]
+    run = m.get("run", {})
+    keys = [k for k in ("stencil", "grid", "mesh", "iters", "fuse",
+                        "fuse_kind", "overlap", "pipeline", "dtype",
+                        "mode", "out", "only") if run.get(k)]
+    lines = [
+        f"manifest  tool={m['tool']}  schema={m['schema']}",
+        f"  backend={p['backend']} ({p['device_count']}x "
+        f"{p['device_kind']})  jax={p['jax_version']}",
+        f"  git={p['git_sha'][:12]}  builder_rev={p.get('builder_rev')}  "
+        f"framework={p['framework_version']}",
+    ]
+    if keys:
+        lines.append("  run: " + "  ".join(f"{k}={run[k]}" for k in keys))
+    return "\n".join(lines)
+
+
+def _attribution_block(cost, summary) -> str:
+    roof = cost.get("roofline", {})
+    comm = cost.get("comm")
+    t_hbm = roof.get("predicted_ms_per_step_hbm")
+    t_ici = roof.get("predicted_ms_per_step_exchange", 0.0)
+    measured = None
+    if summary:
+        steady = (summary.get("runtime") or {}).get("steady") or {}
+        measured = steady.get("ms_per_step_p50")
+
+    rows = [["interior (HBM min traffic)",
+             f"{t_hbm:.4f}" if t_hbm is not None else "-",
+             _fmt_bytes(cost.get("hbm_bytes_per_step_per_device")),
+             "(not separable)"]]
+    if comm:
+        rows.append([
+            f"exchange ({comm['ppermute_rounds_per_pass']} ppermute/"
+            f"pass, width {comm.get('width_m')})",
+            f"{t_ici:.4f}",
+            _fmt_bytes(int(comm["ici_bytes_per_step"])) + "/step",
+            "(not separable)"])
+        # boundary shells: cells within 2m of a sharded wall, re-read/
+        # re-spliced by the overlap path — bandwidth-priced like interior
+        m2 = 2 * (comm.get("width_m") or 0)
+        local = cost.get("local_shape") or []
+        counts = comm.get("sharded_counts") or []
+        if local and counts and t_hbm:
+            inner = 1.0
+            for d, (ext, cnt) in enumerate(zip(local, counts)):
+                if cnt > 1 and ext > m2:
+                    inner *= (ext - m2) / ext
+            shell_frac = 1.0 - inner
+            rows.append([
+                "shell (re-splice band, "
+                f"{shell_frac * 100:.1f}% of cells)",
+                f"{t_hbm * shell_frac:.4f}", "-", "(not separable)"])
+    total_over = roof.get("predicted_mcells_per_s_overlapped")
+    total_serial = roof.get("predicted_mcells_per_s_serial")
+    rows.append(["TOTAL overlapped (exchange hidden)",
+                 f"{max(t_hbm or 0, t_ici or 0):.4f}",
+                 f"{total_over} Mcells/s",
+                 f"{measured:.4f}" if measured is not None else "-"])
+    if comm:
+        rows.append(["TOTAL serial (exchange on critical path)",
+                     f"{(t_hbm or 0) + (t_ici or 0):.4f}",
+                     f"{total_serial} Mcells/s", ""])
+    return "attribution (predicted vs measured)\n" + _table(
+        rows, ["phase", "pred ms/step", "volume", "measured ms/step"])
+
+
+def _runtime_block(summary) -> str:
+    rt = summary.get("runtime") or {}
+    lines = [f"runtime  chunks={rt.get('n_chunks')}  "
+             f"steps={rt.get('steps')}  recompiles={rt.get('recompiles')}"]
+    if "first_chunk_s" in rt:
+        lines.append(f"  compile+first chunk: {rt['first_chunk_s']:.3f}s "
+                     f"({rt['first_chunk_ms_per_step']:.4f} ms/step)")
+    steady = rt.get("steady")
+    if steady:
+        lines.append(
+            f"  steady ({steady['chunks']} chunks): "
+            f"best {steady['ms_per_step_best']:.4f}  "
+            f"p50 {steady['ms_per_step_p50']:.4f}  "
+            f"p90 {steady['ms_per_step_p90']:.4f} ms/step")
+    if "memory_peak_bytes" in rt:
+        lines.append(f"  device memory peak: "
+                     f"{_fmt_bytes(rt['memory_peak_bytes'])}")
+    for k in ("mcells_per_s", "steps", "wall_s", "converged", "residual",
+              "labels_run", "note"):
+        if k in summary:
+            lines.append(f"  {k}: {summary[k]}")
+    hb = summary.get("heartbeat")
+    if hb:
+        lines.append(f"  heartbeat at exit: {hb.get('verdict')}")
+    return "\n".join(lines)
+
+
+def render(path: str) -> str:
+    manifest, events = obs_trace.read_log(path)
+    by_kind: dict = {}
+    for e in events:
+        by_kind.setdefault(e.get("kind"), []).append(e)
+    out = [_manifest_block(manifest)]
+
+    cost = (by_kind.get("costmodel") or [None])[-1]
+    summary = (by_kind.get("summary") or [None])[-1]
+    if cost:
+        out.append(_attribution_block(cost, summary))
+        cc = cost.get("budget_crosscheck")
+        if cc:
+            out.append(
+                f"budget cross-check: slab operands "
+                f"{_fmt_bytes(cc['slab_operand_bytes'])} vs budget.py "
+                f"{_fmt_bytes(cc['budget_bytes'])} — "
+                + ("MATCH" if cc.get("match") else "MISMATCH (models "
+                   "drifted; fix before trusting either)"))
+    if summary:
+        out.append(_runtime_block(summary))
+
+    beats = by_kind.get("heartbeat") or []
+    if beats:
+        out.append("heartbeat verdicts:\n" + _table(
+            [[f"{b['t']:.0f}", b.get("verdict"),
+              (b.get("detail") or "")[:70]] for b in beats],
+            ["t", "verdict", "detail"]))
+    labels = (by_kind.get("label") or []) + (by_kind.get("rung") or [])
+    if labels:
+        rows = []
+        for e in labels[:200]:
+            rows.append([
+                e.get("label") or "x".join(map(str, e.get("mesh", []))),
+                e.get("status") or e.get("mode") or "",
+                e.get("mcells_per_s") if e.get("mcells_per_s")
+                is not None else "-",
+                (e.get("error") or "")[:48]])
+        out.append(f"records ({len(labels)}):\n"
+                   + _table(rows, ["label/mesh", "status", "Mcells/s",
+                                   "error"]))
+    results = by_kind.get("result") or []  # bench.py's headline record
+    for e in results:
+        out.append("bench result: " + "  ".join(
+            f"{k}={e[k]}" for k in ("metric", "value", "unit",
+                                    "vs_baseline", "compute", "backend")
+            if k in e))
+    errors = by_kind.get("error") or []
+    for e in errors:
+        out.append(f"ERROR: {e.get('error')}")
+    if not summary and not errors and not results:
+        out.append("(no summary event — the run is live or died without "
+                   "an epilogue; heartbeat verdicts above say which)")
+    return "\n\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("log", help="telemetry JSONL path")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the manifest and every event against "
+                         "the shared schema; exit nonzero on any "
+                         "invalid record (the tier-1 smoke mode)")
+    a = ap.parse_args(argv)
+    if a.check:
+        try:
+            manifest, events = obs_trace.validate_log(a.log)
+        except (ValueError, OSError) as e:
+            print(f"obs_report --check: INVALID: {e}", file=sys.stderr)
+            return 1
+        print(f"obs_report --check: ok (tool={manifest['tool']}, "
+              f"schema={manifest['schema']}, {len(events)} events)")
+    print(render(a.log))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

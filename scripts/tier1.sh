@@ -24,4 +24,18 @@ python scripts/check_tier_counts.py || rc=1
 # (seconds); the perf claims it pins can regress with every value test
 # still green (see scripts/check_pipeline_structure.py).
 python scripts/check_pipeline_structure.py || rc=1
+# Telemetry smoke: a CPU CLI run must emit a schema-valid manifest and
+# obs_report must validate + render it (the shared-schema guarantee of
+# mpi_cuda_process_tpu/obs — all four entry points emit what this
+# validator accepts, so the gate a builder runs checks the schema too).
+rm -f /tmp/_t1_obs.jsonl
+timeout -k 10 180 python -c "
+from cpuforce import force_cpu; force_cpu()
+from mpi_cuda_process_tpu import cli
+cli.run(cli.config_from_args(
+    ['--stencil', 'heat2d', '--grid', '32,128', '--iters', '8',
+     '--log-every', '2', '--telemetry', '/tmp/_t1_obs.jsonl']))
+" || rc=1
+timeout -k 10 120 python scripts/obs_report.py /tmp/_t1_obs.jsonl --check \
+  > /dev/null || rc=1
 exit $rc

@@ -23,6 +23,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def bench():
     # Plain import: bench.py's __main__ guards keep the watchdog thread and
     # main() from running; conftest already forced the CPU platform.
+    # BENCH_OBS_PROBE=0 keeps the wedged-path records' heartbeat probe
+    # (a pair of bounded subprocesses) out of the unit tests — the probe
+    # itself is covered in tests/test_obs.py with an injected stub.
+    os.environ["BENCH_OBS_PROBE"] = "0"
     sys.path.insert(0, REPO)
     import bench as mod
 
