@@ -143,6 +143,23 @@ def _wedge_context():
     return out
 
 
+def _ledger_wedged(rec) -> None:
+    """Route a wedged-path record into the campaign ledger, QUARANTINED.
+
+    The stale 0.0 value then exists in the durable cross-round table as
+    an explicitly-quarantined row (with its heartbeat verdict and the
+    ``last_real_measurement`` pointer) — downstream tooling reading the
+    ledger for baselines can never mistake it for a measurement.
+    NEVER raises (watchdog-thread safety).
+    """
+    try:
+        from mpi_cuda_process_tpu.obs import ledger as _ledger
+
+        _ledger.record_wedged_bench(rec)
+    except Exception:
+        pass
+
+
 def _stale_fallback_record():
     """The watchdog's record when the backend is wedged.  NEVER raises —
     an exception here would kill the watchdog thread and leave the driver
@@ -191,6 +208,7 @@ def _stale_fallback_record():
             if last is not None:
                 rec["last_real_measurement"] = last
             rec.update(_wedge_context())
+            _ledger_wedged(rec)
             return rec
     except Exception:
         pass
@@ -207,6 +225,7 @@ def _stale_fallback_record():
         rec.update(_wedge_context())
     except Exception:
         pass
+    _ledger_wedged(rec)
     return rec
 
 
@@ -423,6 +442,14 @@ def main():
     tel = _write_bench_telemetry(rec, grid, steps, fuse, backend)
     if tel:
         rec["telemetry"] = tel
+        # every round's headline lands in the durable cross-round ledger
+        # (quarantine rules applied on ingest; never breaks the bench)
+        try:
+            from mpi_cuda_process_tpu.obs import ledger as _ledger
+
+            _ledger.ingest_log(tel)
+        except Exception:
+            pass
     if backend == "tpu" and not suspect and not rec.get("suspect_512cubed"):
         # Never seed the last-known-good cache with a noise-flagged record
         # (either grid size): the stale-fallback replay is the one path

@@ -966,6 +966,16 @@ def main():
                        runnable_after=count_runnable(args.out))
         session.close()
 
+    # Every campaign run updates the durable cross-round ledger from its
+    # results table (idempotent append; errored/suspect labels land
+    # quarantined).  Never load-bearing for the campaign itself.
+    try:
+        from mpi_cuda_process_tpu.obs import ledger as _ledger
+
+        _ledger.ingest_results(args.out)
+    except Exception:  # noqa: BLE001
+        pass
+
     if not args.only and os.path.exists(args.out):
         with open(args.out) as fh:
             print(fh.read())

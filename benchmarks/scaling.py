@@ -329,6 +329,15 @@ def main(argv=None) -> int:
         if session is not None:
             session.finish()
             session.close()
+    if session is not None:
+        # rung rows enter the durable cross-round ledger (idempotent;
+        # quarantine rules on ingest; never load-bearing)
+        try:
+            from mpi_cuda_process_tpu.obs import ledger as _ledger
+
+            _ledger.ingest_log(session.path)
+        except Exception:  # noqa: BLE001
+            pass
     return rc
 
 

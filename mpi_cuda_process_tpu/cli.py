@@ -75,7 +75,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--render", action="store_true",
                    help="ASCII-render the final grid")
     p.add_argument("--profile-dir", default=None,
-                   help="write a jax.profiler trace for the run")
+                   help="write a jax.profiler trace for the WHOLE run "
+                        "(compile included; raw trace only — for "
+                        "chunk-scoped attribution use --profile)")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="device-trace attribution (obs/profile.py): "
+                        "scope a jax.profiler trace to ONE steady-state "
+                        "chunk (the first post-compile chunk; start/"
+                        "stop strictly at chunk boundaries — the jitted "
+                        "step is untouched), then parse the trace into "
+                        "interior-compute / ppermute / exposed-ICI "
+                        "buckets and a measured overlap efficiency "
+                        "(1 - exposed/total comm), logged and — with "
+                        "--telemetry — recorded as a 'profile' event "
+                        "next to the costmodel roofline so predicted-"
+                        "vs-measured hiding is one obs_report line.  On "
+                        "CPU (or a trace with no device events) the "
+                        "record says 'attribution: unavailable' rather "
+                        "than fabricating zeros")
     p.add_argument("--telemetry", default=None, metavar="PATH",
                    help="write a JSONL telemetry event log: a "
                         "provenance-stamped run manifest (config, mesh, "
@@ -183,7 +200,7 @@ def config_from_args(argv=None) -> RunConfig:
         checkpoint_every=a.checkpoint_every, checkpoint_dir=a.checkpoint_dir,
         checkpoint_backend=a.checkpoint_backend,
         resume=a.resume, render=a.render, profile_dir=a.profile_dir,
-        telemetry=a.telemetry,
+        profile=a.profile, telemetry=a.telemetry,
         compute=a.compute, overlap=a.overlap, pipeline=a.pipeline,
         ensemble=a.ensemble,
         fuse=a.fuse, fuse_kind=a.fuse_kind,
@@ -750,6 +767,15 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
     if cfg.debug_checks and cfg.fuse:
         raise ValueError("--debug-checks excludes --fuse (the fused "
                          "kernel replaces the step being instrumented)")
+    if cfg.profile and cfg.profile_dir:
+        raise ValueError("--profile and --profile-dir both open a "
+                         "jax.profiler session and jax forbids nesting "
+                         "them; pick the chunk-scoped (--profile) or "
+                         "whole-run (--profile-dir) trace")
+    if cfg.profile and cfg.tol > 0:
+        raise ValueError("--profile scopes one steady-state chunk; "
+                         "--tol runs inside a single while_loop with no "
+                         "chunk boundary to scope")
     _check_mem_budget(cfg)
     mesh_lib.bootstrap_distributed()
     st, step_fn, fields, start_step = build(cfg)
@@ -875,16 +901,51 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
         runner_factory = functools.partial(
             driver.make_checked_runner, use_checkify=not _uses_mesh(cfg))
 
+    observer = session.recorder if session is not None else None
+    prof = None
+    if cfg.profile:
+        from .obs import profile as profile_lib
+        from .obs import runtime as runtime_lib
+
+        calls = remaining // step_unit
+        if interval == 0 and calls >= 2:
+            # no logging cadence: synthesize one chunk boundary so a
+            # steady-state chunk (post compile+warmup) exists to scope
+            interval = (calls + 1) // 2
+        n_chunks = -(-calls // interval) if interval else 1
+        # chunk 1 = first post-compile chunk; a single-chunk run scopes
+        # chunk 0 (compile included — give the run more iters to split)
+        prof = profile_lib.ChunkProfiler(
+            cfg.profile, target_chunk=1 if n_chunks >= 2 else 0)
+        if observer is None:
+            observer = runtime_lib.RuntimeRecorder(step_unit=step_unit)
+        observer.profiler = prof
+
     t0 = time.perf_counter()
-    with _profiled(cfg):
-        fields = driver.run_simulation(
-            st, fields, remaining // step_unit, step_fn=step_fn,
-            log_every=interval, callback=callback,
-            start_step=start_step // step_unit,
-            runner_factory=runner_factory,
-            observer=session.recorder if session is not None else None)
-        fields = jax.block_until_ready(fields)
+    try:
+        with _profiled(cfg):
+            fields = driver.run_simulation(
+                st, fields, remaining // step_unit, step_fn=step_fn,
+                log_every=interval, callback=callback,
+                start_step=start_step // step_unit,
+                runner_factory=runner_factory,
+                observer=observer)
+            fields = jax.block_until_ready(fields)
+    finally:
+        if prof is not None:
+            prof.close()  # never leave a trace session open (jax
+            # refuses nesting; the error path must not poison the next run)
     dt = time.perf_counter() - t0
+
+    if prof is not None:
+        from .obs import profile as profile_lib
+
+        att = profile_lib.attribution_record(
+            cfg.profile, profiled_chunk=prof.profiled_chunk,
+            error=prof.error)
+        log.info("profile: %s", profile_lib.format_attribution(att))
+        if session is not None:
+            session.event("profile", **att)
     if cfg.dump_every and cfg.dump_dir:
         native.wait_all()  # drain the async dump queue; surfaces IO errors
     mcells = cells * remaining / dt / 1e6

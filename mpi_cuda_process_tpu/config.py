@@ -32,7 +32,11 @@ class RunConfig:
     checkpoint_backend: str = "npy"  # npy (host gather) | orbax (per-shard)
     resume: bool = False
     render: bool = False
-    profile_dir: Optional[str] = None
+    profile_dir: Optional[str] = None  # whole-run jax.profiler trace
+    # chunk-scoped jax.profiler trace + device-trace attribution
+    # (obs/profile.py): the profiler brackets ONE steady-state chunk and
+    # the parsed trace yields a measured overlap efficiency; None = off
+    profile: Optional[str] = None
     compute: str = "auto"  # auto | jnp | pallas
     overlap: bool = False  # explicit interior/boundary split for comm overlap
     # cross-pass pipelined halo exchange (slab-carry scan): pass i+1's

@@ -374,11 +374,15 @@ def run_simulation(
     across chunks and resumes).
 
     ``observer`` (telemetry, ``obs/runtime.py``) receives
-    ``record_chunk(steps, seconds)`` with each chunk's wall time,
-    measured around the runner call with a ``block_until_ready`` fence.
+    ``begin_chunk()`` / ``record_chunk(steps, seconds)`` around each
+    chunk, the wall time measured with a ``block_until_ready`` fence.
     Strictly a chunk-boundary hook: the jitted step/scan is byte-
     identical with and without an observer (pinned by jaxpr inspection
-    in tests/test_obs.py), so the hot path pays nothing.
+    in tests/test_obs.py), so the hot path pays nothing.  An observer
+    alone (no callback) still gets chunked execution when ``log_every``
+    is set — the hook a chunk-scoped profiler (``obs/profile.py``)
+    needs to see a steady-state chunk boundary without any logging
+    side-channel.
     """
     if step_fn is None:
         step_fn = make_step(stencil, fields[0].shape)
@@ -396,7 +400,7 @@ def run_simulation(
         observer.record_chunk(n, time.perf_counter() - t0)
         return out
 
-    if not log_every or callback is None:
+    if not log_every or (callback is None and observer is None):
         return _run_chunk(runner_factory(step_fn, n_steps), fields,
                           n_steps, start_step)
 
@@ -410,5 +414,6 @@ def run_simulation(
             runners[chunk] = runner_factory(step_fn, chunk)
         fields = _run_chunk(runners[chunk], fields, chunk, abs_step)
         done += chunk
-        callback(done, fields)
+        if callback is not None:
+            callback(done, fields)
     return fields

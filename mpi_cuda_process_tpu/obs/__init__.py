@@ -9,8 +9,19 @@ validator is the single definition) and the same event vocabulary:
 * ``costmodel``  — static flop/HBM/ppermute counters + roofline
 * ``chunk``      — per-chunk wall time, recompile flag, memory peaks
 * ``heartbeat``  — STALLED/WEDGED/RECOVERED verdicts from the watcher
+* ``profile``    — device-trace attribution of one profiled chunk
+  (``profile.py``: measured overlap efficiency, or an explicit
+  ``attribution: unavailable`` — never fabricated zeros)
 * ``label`` / ``rung`` — benchmark-harness progress records
 * ``error`` / ``summary`` — how the run ended
+
+Two sibling stores complete the layer: ``profile.py`` wraps a
+``jax.profiler`` session scoped to one steady-state chunk and parses
+the emitted trace into interior-compute / exchange / exposed-ICI
+buckets; ``ledger.py`` is the append-only cross-round campaign ledger
+(every manifest ingested, 0.0/stale/suspect values quarantined with
+their heartbeat verdict, best-known-value-with-provenance per label —
+what ``scripts/perf_gate.py`` gates against).
 
 :func:`open_session` is the one-call wiring: trace writer + manifest +
 runtime recorder + heartbeat, bundled in a :class:`Session`.  Telemetry

@@ -89,10 +89,18 @@ class RuntimeRecorder:
     ``step_unit`` converts the driver's call-unit chunk sizes into real
     steps (``--fuse K`` advances K steps per call).  ``last_progress``
     (monotonic seconds) is the liveness signal the heartbeat watches.
+
+    ``profiler`` (an :class:`~.profile.ChunkProfiler`, optional) rides
+    the same chunk boundaries: its ``begin_chunk``/``end_chunk`` run
+    strictly host-side where the driver already calls this recorder, so
+    a ``--profile`` run scopes its ``jax.profiler`` trace to exactly
+    one chunk without touching the jitted step (the zero-ops invariant
+    extends to the profiler — pinned by tests/test_obs_profile.py).
     """
 
-    def __init__(self, trace=None, step_unit: int = 1):
+    def __init__(self, trace=None, step_unit: int = 1, profiler=None):
         self.trace = trace
+        self.profiler = profiler
         self.step_unit = max(1, int(step_unit))
         self.chunks: List[Dict[str, Any]] = []
         self.recompiles = 0
@@ -113,6 +121,8 @@ class RuntimeRecorder:
         ``begin_chunk`` and ``record_chunk`` implicate the scan itself.
         """
         self.mark()
+        if self.profiler is not None:
+            self.profiler.begin_chunk(len(self.chunks))
         self._chunk_begin_compiles = compile_events_seen()
 
     def record_chunk(self, steps: int, seconds: float) -> Dict[str, Any]:
@@ -124,6 +134,8 @@ class RuntimeRecorder:
         self.mark()
         real_steps = int(steps) * self.step_unit
         n = len(self.chunks)
+        profiled = (self.profiler is not None
+                    and self.profiler.end_chunk(n))
         recompiled = False
         if self._chunk_begin_compiles is not None:
             during = compile_events_seen() - self._chunk_begin_compiles
@@ -139,6 +151,8 @@ class RuntimeRecorder:
             "ms_per_step": round(seconds * 1e3 / max(1, real_steps), 6),
             "recompiled": recompiled,
         }
+        if profiled:
+            rec["profiled"] = True
         mem = device_memory_stats()
         if mem:
             rec["memory"] = mem

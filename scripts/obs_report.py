@@ -18,7 +18,9 @@ manifest schema) and prints:
 
 ``--check`` validates the log against the shared schema and exits
 nonzero on any invalid record — the mode ``scripts/tier1.sh`` runs, so
-a tool drifting off-schema fails the gate.
+a tool drifting off-schema fails the gate.  A pallas-retry sibling
+(``PATH.retry.jsonl``, written by cli.run's auto-retry) is validated
+against the same schema when present.
 
 Safe on a wedged box: the CPU backend is forced before any jax use and
 nothing here touches a device.
@@ -67,7 +69,7 @@ def _manifest_block(m) -> str:
     run = m.get("run", {})
     keys = [k for k in ("stencil", "grid", "mesh", "iters", "fuse",
                         "fuse_kind", "overlap", "pipeline", "dtype",
-                        "mode", "out", "only") if run.get(k)]
+                        "mode", "out", "only", "profile") if run.get(k)]
     lines = [
         f"manifest  tool={m['tool']}  schema={m['schema']}",
         f"  backend={p['backend']} ({p['device_count']}x "
@@ -130,6 +132,42 @@ def _attribution_block(cost, summary) -> str:
         rows, ["phase", "pred ms/step", "volume", "measured ms/step"])
 
 
+def _profile_block(prof, cost) -> str:
+    """Predicted-vs-measured hiding in one block (the --profile event).
+
+    The roofline's ``overlapped`` prediction assumes the exchange fully
+    hidden (efficiency 1.0) and ``serial`` fully exposed (0.0); the
+    device trace says where the run actually landed.
+    """
+    head = "device-trace attribution"
+    chunk = prof.get("profiled_chunk")
+    if prof.get("attribution") != "ok":
+        return (f"{head}: unavailable — "
+                f"{prof.get('reason') or 'no reason recorded'}"
+                f"  (dir {prof.get('profile_dir')})")
+    lines = [f"{head} (profiled chunk {chunk}):"]
+    lines.append(
+        f"  device busy {prof['device_busy_us'] / 1e3:.3f} ms = "
+        f"compute {prof['compute_us'] / 1e3:.3f} ms"
+        f" + exchange {prof['comm_us'] / 1e3:.3f} ms"
+        f" (exposed {prof['exposed_comm_us'] / 1e3:.3f} ms)"
+        f"  [{prof['n_device_events']} device events]")
+    eff = prof.get("overlap_efficiency")
+    roof = (cost or {}).get("roofline") or {}
+    if eff is None:
+        lines.append("  no exchange ops in the trace (unsharded run): "
+                     "nothing to hide")
+    else:
+        pred = (f"roofline brackets: overlapped "
+                f"{roof.get('predicted_mcells_per_s_overlapped')} vs "
+                f"serial {roof.get('predicted_mcells_per_s_serial')} "
+                f"Mcells/s" if roof else "no costmodel event to "
+                                         "compare against")
+        lines.append(f"  measured overlap efficiency {eff:.1%} "
+                     f"(1.0 = exchange fully hidden) — {pred}")
+    return "\n".join(lines)
+
+
 def _runtime_block(summary) -> str:
     rt = summary.get("runtime") or {}
     lines = [f"runtime  chunks={rt.get('n_chunks')}  "
@@ -176,6 +214,9 @@ def render(path: str) -> str:
                 f"{_fmt_bytes(cc['budget_bytes'])} — "
                 + ("MATCH" if cc.get("match") else "MISMATCH (models "
                    "drifted; fix before trusting either)"))
+    profs = by_kind.get("profile") or []
+    if profs:
+        out.append(_profile_block(profs[-1], cost))
     if summary:
         out.append(_runtime_block(summary))
 
@@ -222,13 +263,22 @@ def main(argv=None) -> int:
                          "invalid record (the tier-1 smoke mode)")
     a = ap.parse_args(argv)
     if a.check:
-        try:
-            manifest, events = obs_trace.validate_log(a.log)
-        except (ValueError, OSError) as e:
-            print(f"obs_report --check: INVALID: {e}", file=sys.stderr)
-            return 1
-        print(f"obs_report --check: ok (tool={manifest['tool']}, "
-              f"schema={manifest['schema']}, {len(events)} events)")
+        # the pallas auto-retry writes its own log at PATH.retry.jsonl
+        # (cli.run); when present it must pass the same schema — a
+        # sibling drifting off-schema is the same gate failure
+        to_check = [a.log]
+        retry = a.log + ".retry.jsonl"
+        if os.path.exists(retry):
+            to_check.append(retry)
+        for path in to_check:
+            try:
+                manifest, events = obs_trace.validate_log(path)
+            except (ValueError, OSError) as e:
+                print(f"obs_report --check: INVALID: {e}", file=sys.stderr)
+                return 1
+            print(f"obs_report --check: ok (tool={manifest['tool']}, "
+                  f"schema={manifest['schema']}, {len(events)} events"
+                  + (", retry sibling" if path != a.log else "") + ")")
     print(render(a.log))
     return 0
 

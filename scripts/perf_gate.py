@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Perf regression gate: a fresh telemetry manifest vs the ledger baseline.
+
+Reads one telemetry JSONL (any of the four obs/ tools), derives its
+measurement rows (``obs/ledger.rows_from_log``), and compares each
+against the best-known baseline for the same label on the same backend
+(``obs/ledger.best_known`` — quarantined rows are structurally excluded,
+so a stale/0.0/wedged record can never be the number a run is judged
+against).  Verdicts, with a configurable relative noise band
+(``--noise``, default 10%):
+
+    IMPROVED      fresh >= baseline * (1 + noise)
+    OK            fresh >  baseline * (1 - noise)
+    REGRESSED     fresh <= baseline * (1 - noise)
+    NO_BASELINE   no ok ledger row for this label x backend
+    QUARANTINED   the fresh row itself failed quarantine (0.0, stale,
+                  suspect, backend mismatch, wedged heartbeat) — it is
+                  neither scored nor ever a baseline
+
+Exit status: 0 clean, 1 when any row REGRESSED (CI-gate mode), 2 on
+usage/IO errors.  ``--dry`` always exits 0 (the tier-1 smoke mode —
+the table still prints).  ``--update-ledger`` appends the fresh rows
+(ok AND quarantined, idempotently) after the verdicts are computed, so
+one invocation both gates a round and makes it the next round's
+baseline; ``--backfill`` runs the one-shot historical ingest
+(BENCH_r0*.json + benchmarks/results_r0*.json) instead of gating.
+
+Safe on a wedged box: the CPU backend is forced before the package
+(and hence any jax backend) loads; the ledger itself never touches a
+device.
+
+Usage:
+    python scripts/perf_gate.py RUN.jsonl [--ledger PATH] [--noise F]
+                                [--dry] [--update-ledger]
+    python scripts/perf_gate.py --backfill [--ledger PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from cpuforce import force_cpu  # noqa: E402
+
+force_cpu()  # before the package (and hence any jax backend) loads
+
+from mpi_cuda_process_tpu.obs import ledger as ledger_lib  # noqa: E402
+
+VERDICT_ORDER = ("REGRESSED", "QUARANTINED", "NO_BASELINE", "OK",
+                 "IMPROVED")
+
+
+def judge(fresh_row, baseline_row, noise: float):
+    """One row's verdict: ``(verdict, ratio_or_None)``."""
+    if fresh_row.get("status") != "ok":
+        return "QUARANTINED", None
+    if baseline_row is None:
+        return "NO_BASELINE", None
+    ratio = float(fresh_row["value"]) / float(baseline_row["value"])
+    if ratio >= 1.0 + noise:
+        return "IMPROVED", ratio
+    if ratio > 1.0 - noise:
+        return "OK", ratio
+    return "REGRESSED", ratio
+
+
+def gate(manifest_path: str, ledger_path: str, noise: float):
+    """Verdict rows for one manifest: list of dicts, one per label."""
+    fresh = ledger_lib.rows_from_log(manifest_path)
+    source = f"telemetry:{os.path.abspath(manifest_path)}"
+    # the same log may already be in the ledger (the tools auto-ingest);
+    # a run must never be its own baseline
+    history = [r for r in ledger_lib.read_rows(ledger_path)
+               if r["source"] != source]
+    baselines = ledger_lib.best_known(history)
+    out = []
+    for row in fresh:
+        base = baselines.get(ledger_lib.baseline_key(row))
+        verdict, ratio = judge(row, base, noise)
+        out.append({
+            "label": row["label"],
+            "backend": row["key"].get("backend"),
+            "verdict": verdict,
+            "value": row.get("value"),
+            "unit": row.get("unit"),
+            "baseline": base["value"] if base else None,
+            "ratio": round(ratio, 4) if ratio is not None else None,
+            "quarantine": row.get("quarantine"),
+            "baseline_source": base["source"] if base else None,
+            "baseline_measured_at": base.get("measured_at")
+            if base else None,
+        })
+    return out, fresh
+
+
+def _table(rows):
+    header = ["label", "verdict", "fresh", "baseline", "ratio", "why/src"]
+    body = []
+    for r in rows:
+        why = r["quarantine"] if r["verdict"] == "QUARANTINED" \
+            else (r["baseline_source"] or "")
+        body.append([
+            r["label"][:58], r["verdict"],
+            "-" if r["value"] is None else f"{r['value']:g}",
+            "-" if r["baseline"] is None else f"{r['baseline']:g}",
+            "-" if r["ratio"] is None else f"{r['ratio']:.3f}",
+            (why or "")[:44]])
+    widths = [max(len(str(r[i])) for r in [header] + body)
+              for i in range(len(header))]
+    lines = ["  ".join(str(h).ljust(w) for h, w in zip(header, widths)),
+             "  ".join("-" * w for w in widths)]
+    lines += ["  ".join(str(c).ljust(w) for c, w in zip(r, widths))
+              for r in body]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("manifest", nargs="?",
+                    help="fresh telemetry JSONL to gate")
+    ap.add_argument("--ledger", default=None,
+                    help="ledger path (default: OBS_LEDGER_PATH or "
+                         "benchmarks/ledger.jsonl)")
+    ap.add_argument("--noise", type=float, default=0.10,
+                    help="relative noise band (default 0.10 = +/-10%%)")
+    ap.add_argument("--dry", action="store_true",
+                    help="print the verdict table but always exit 0 "
+                         "(the tier-1 smoke mode)")
+    ap.add_argument("--update-ledger", action="store_true",
+                    help="append the fresh rows to the ledger after "
+                         "gating (idempotent)")
+    ap.add_argument("--backfill", action="store_true",
+                    help="one-shot historical ingest instead of gating")
+    a = ap.parse_args(argv)
+    ledger_path = a.ledger or ledger_lib.default_ledger_path()
+
+    if a.backfill:
+        out = ledger_lib.backfill(ledger_path=ledger_path)
+        print(f"perf_gate --backfill: {out['found']} rows found, "
+              f"{out['appended']} appended "
+              f"({out['quarantined']} quarantined) -> {ledger_path}")
+        return 0
+    if not a.manifest:
+        ap.error("need a telemetry manifest to gate (or --backfill)")
+
+    try:
+        verdicts, fresh = gate(a.manifest, ledger_path, a.noise)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: cannot gate {a.manifest}: {e}",
+              file=sys.stderr)
+        return 2
+
+    verdicts.sort(key=lambda r: VERDICT_ORDER.index(r["verdict"]))
+    counts = {}
+    for r in verdicts:
+        counts[r["verdict"]] = counts.get(r["verdict"], 0) + 1
+    print(f"perf_gate: {a.manifest} vs {ledger_path} "
+          f"(noise +/-{a.noise:.0%})")
+    print(_table(verdicts) if verdicts else "(no measurement rows in "
+                                           "this manifest)")
+    print("summary: " + "  ".join(
+        f"{v}={counts.get(v, 0)}" for v in VERDICT_ORDER))
+
+    if a.update_ledger:
+        n = ledger_lib.append_rows(fresh, ledger_path)
+        print(f"ledger updated: {n} rows appended -> {ledger_path}")
+
+    regressed = counts.get("REGRESSED", 0)
+    if regressed and not a.dry:
+        print(f"perf_gate: FAIL — {regressed} label(s) regressed past "
+              f"the {a.noise:.0%} noise band", file=sys.stderr)
+        return 1
+    if regressed:
+        print(f"perf_gate: --dry — {regressed} regression(s) reported, "
+              "exit forced 0")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

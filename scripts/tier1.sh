@@ -24,18 +24,30 @@ python scripts/check_tier_counts.py || rc=1
 # (seconds); the perf claims it pins can regress with every value test
 # still green (see scripts/check_pipeline_structure.py).
 python scripts/check_pipeline_structure.py || rc=1
-# Telemetry smoke: a CPU CLI run must emit a schema-valid manifest and
-# obs_report must validate + render it (the shared-schema guarantee of
+# Telemetry + profile smoke: a CPU CLI run must emit a schema-valid
+# manifest (with a chunk-scoped --profile whose attribution degrades
+# HONESTLY on CPU — 'unavailable', never zeros) and obs_report must
+# validate + render it (the shared-schema guarantee of
 # mpi_cuda_process_tpu/obs — all four entry points emit what this
 # validator accepts, so the gate a builder runs checks the schema too).
-rm -f /tmp/_t1_obs.jsonl
+rm -f /tmp/_t1_obs.jsonl /tmp/_t1_ledger.jsonl
+rm -rf /tmp/_t1_prof
 timeout -k 10 180 python -c "
 from cpuforce import force_cpu; force_cpu()
 from mpi_cuda_process_tpu import cli
 cli.run(cli.config_from_args(
     ['--stencil', 'heat2d', '--grid', '32,128', '--iters', '8',
-     '--log-every', '2', '--telemetry', '/tmp/_t1_obs.jsonl']))
+     '--log-every', '2', '--telemetry', '/tmp/_t1_obs.jsonl',
+     '--profile', '/tmp/_t1_prof']))
 " || rc=1
 timeout -k 10 120 python scripts/obs_report.py /tmp/_t1_obs.jsonl --check \
   > /dev/null || rc=1
+# Ledger + perf-gate smoke against a throwaway ledger: backfill the
+# historical BENCH_r0*/results_r0* files (quarantine rules exercised on
+# the real wedge rounds), ingest the smoke manifest, and run the gate in
+# --dry mode — the full measurement->ledger->gate loop every build.
+timeout -k 10 120 python scripts/perf_gate.py --backfill \
+  --ledger /tmp/_t1_ledger.jsonl > /dev/null || rc=1
+timeout -k 10 120 python scripts/perf_gate.py /tmp/_t1_obs.jsonl --dry \
+  --update-ledger --ledger /tmp/_t1_ledger.jsonl || rc=1
 exit $rc

@@ -33,6 +33,15 @@ def _tpu_tier_selected(config) -> bool:
 
 
 def pytest_configure(config):
+    # The benchmark drivers auto-ingest into the campaign ledger
+    # (obs/ledger.py); tests that exercise them must never append to the
+    # repo's committed benchmarks/ledger.jsonl.  An explicit pre-set
+    # path (a test harness choosing its own) is left untouched.
+    if "OBS_LEDGER_PATH" not in os.environ:
+        import tempfile
+
+        os.environ["OBS_LEDGER_PATH"] = os.path.join(
+            tempfile.mkdtemp(prefix="obs-ledger-test-"), "ledger.jsonl")
     if _tpu_tier_selected(config):
         return  # real backend stays for the -m tpu smoke tier
     # Leave an explicit pre-set device count untouched so an outer harness

@@ -486,3 +486,25 @@ def test_obs_report_renders_attribution_and_checks(cli_log, tmp_path,
     bad = tmp_path / "bad.jsonl"
     bad.write_text('{"kind": "manifest"}\n')
     assert report.main([str(bad), "--check"]) == 1
+
+
+def test_obs_report_check_validates_retry_sibling(tmp_path, capsys):
+    """Satellite: the pallas-retry sibling log (PATH.retry.jsonl,
+    written by cli.run's auto-retry) is validated by --check when
+    present — on the same schema, with the same nonzero-exit rule."""
+    report = _load_script("obs_report_retry_t", "scripts/obs_report.py")
+    main_log = tmp_path / "run.jsonl"
+    with trace.TraceWriter(str(main_log)) as w:
+        w.write_manifest(trace.build_manifest("cli", {"x": 1}))
+        w.event("error", error="Mosaic exploded")
+    retry = tmp_path / "run.jsonl.retry.jsonl"
+    with trace.TraceWriter(str(retry)) as w:
+        w.write_manifest(trace.build_manifest("cli", {"x": 1}))
+        w.event("summary", mcells_per_s=1.0)
+    assert report.main([str(main_log), "--check"]) == 0
+    out = capsys.readouterr().out
+    assert "retry sibling" in out
+
+    # an off-schema sibling fails the gate even when the main log is ok
+    retry.write_text('{"kind": "manifest"}\n')
+    assert report.main([str(main_log), "--check"]) == 1
