@@ -76,7 +76,13 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
     change) | streamK_shard / streamK_meshZxY
     (the STREAMING kernel sharded: z-only mesh of all devices /
     a pinned 2-axis mesh via the round-8 y-slab+corner splice — the
-    kind x mesh A/B rows) | copy (harness-calibration
+    kind x mesh A/B rows) | rdmaK / rdmaK_meshZxY (the sharded
+    STREAMING kernel with the IN-KERNEL remote-DMA exchange,
+    stepper exchange='rdma': boundary slabs ride double-buffered VMEM
+    rings into the neighbor via make_async_remote_copy, zero XLA
+    ppermute in the step — the A/B against streamK_shard /
+    streamK_meshZxY prices the exchange transport, same kernel class
+    on both rows) | copy (harness-calibration
     1R+1W elementwise scan).
     """
     kw = dict(params or {})
@@ -169,6 +175,60 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
         step = make_stream_fused_step(st, grid, step_unit, tiles=tiles)
         if step is None:
             raise ValueError(f"untileable stream k={step_unit} for {grid}")
+    elif compute.startswith("rdma"):
+        # sharded STREAMING kernel with the in-kernel remote-DMA
+        # exchange (stepper exchange="rdma"): same kernel class as the
+        # streamK_shard/_mesh rows, only the transport changes — the
+        # A/B pair prices ppermute-on-HBM-slabs vs device-initiated
+        # VMEM-ring RDMA.  The built step must really carry rdma (and
+        # the streaming kernel) or the label refuses: a transport
+        # fallback must never be priced under this label.
+        from mpi_cuda_process_tpu import make_mesh, shard_fields
+        from mpi_cuda_process_tpu.parallel.stepper import (
+            make_sharded_fused_step,
+        )
+
+        spec = compute[len("rdma"):]
+        mesh_zy = None
+        if "_mesh" in spec:
+            spec, meshspec = spec.split("_mesh", 1)
+            mz, my = meshspec.split("x", 1)
+            mesh_zy = (int(mz), int(my))
+        step_unit, tiles = _parse_kspec(spec)
+        if tiles is not None:
+            raise ValueError("rdma labels take no tile spec")
+        n_dev = len(jax.devices())
+        need = mesh_zy[0] * mesh_zy[1] if mesh_zy else 2
+        if n_dev < need:
+            # environmental, not structural: retried on every run
+            raise ValueError(
+                f"rdma labels need >= {need} devices (have {n_dev})")
+        mesh = make_mesh((mesh_zy[0], mesh_zy[1], 1) if mesh_zy
+                         else (n_dev, 1, 1))
+        step = make_sharded_fused_step(st, mesh, grid, step_unit,
+                                       kind="stream", exchange="rdma")
+        if step is None:
+            raise ValueError(
+                f"untileable rdma stream k={step_unit} for {grid} on "
+                f"mesh {tuple(mesh.shape.values())}")
+        if getattr(step, "_exchange", None) != "rdma" or not str(
+                getattr(step, "_padfree_kind", "")).startswith("stream"):
+            raise ValueError(
+                "rdma label did not build the remote-DMA streaming "
+                f"step (kind={getattr(step, '_padfree_kind', None)!r}, "
+                f"exchange={getattr(step, '_exchange', None)!r}) — "
+                "must not price a different path under this label")
+        if getattr(step, "_rdma_backend", None) != "pallas-rdma":
+            # the interpret-emulated path is a CPU test vehicle, never
+            # a measurement — the same honesty rule as bench.py's
+            # backend-tagged fallbacks
+            raise ValueError(
+                "rdma label built the interpret-emulated exchange "
+                f"({getattr(step, '_rdma_backend', None)!r}) — a "
+                "measurement row needs the compiled pallas-rdma path")
+        mk = lambda: shard_fields(  # noqa: E731
+            init_state(st, grid, kind="auto"), mesh, st.ndim)
+        return _time_scan(step, mk, grid, steps, reps, step_unit)
     elif compute.startswith("pipe"):
         # CROSS-PASS pipelined sharded temporal blocking: overlap split
         # + the slab-carry scan (pass i+1's exchange issued from pass
@@ -617,6 +677,24 @@ CONFIGS = [
      "pipe4"),
     ("wave3d_512_f32_pipe4_mesh8x8", "wave3d", (512, 512, 512), 8,
      "float32", "pipe4_mesh8x8"),
+    # D11 (round 12): IN-KERNEL REMOTE-DMA exchange A/B — the sharded
+    # streaming kernel with exchange='rdma' (boundary slabs pushed into
+    # the neighbor's VMEM rings by make_async_remote_copy; zero XLA
+    # ppermute, no HBM slab transient) against the round-8
+    # streamK_shard/_mesh8x8 rows: SAME kernel class both sides, only
+    # the transport differs, so the pair prices exactly the exchange
+    # path.  New compile class (collective pallas_call: remote DMA +
+    # barrier/credit semaphores) — cheapest first to prove it compiles;
+    # needs >= 2 devices (z-ring) / a 64-chip slice (_mesh8x8), fast
+    # environmental decline + retry elsewhere.
+    ("heat3d_512_f32_rdma4", "heat3d", (512, 512, 512), 10, "float32",
+     "rdma4"),
+    ("wave3d_512_f32_rdma4", "wave3d", (512, 512, 512), 8, "float32",
+     "rdma4"),
+    ("heat3d_512_f32_rdma4_mesh8x8", "heat3d", (512, 512, 512), 10,
+     "float32", "rdma4_mesh8x8"),
+    ("wave3d_512_f32_rdma4_mesh8x8", "wave3d", (512, 512, 512), 8,
+     "float32", "rdma4_mesh8x8"),
 ]
 
 # Tier-D labels: new large Mosaic compiles.  A hang here is plausibly a
@@ -642,7 +720,10 @@ _RISKY = frozenset(
 # rev 8: the slab-carry pipelined stepper (pipeline=True) — new pipeK
 # labels exist, and the pad-free builders are now constructed through
 # one more wrapper layer (pipeline bodies), so older declines retry.
-BUILDER_REV = 8
+# rev 9: the in-kernel remote-DMA exchange (exchange='rdma') — new
+# rdmaK labels exist, and the streaming steppers grew the transport
+# layer (halo.RdmaTransport threading), so older declines retry.
+BUILDER_REV = 9
 
 
 def _skip_cached(cached):

@@ -77,7 +77,8 @@ def _time_run(run, fields, reps: int) -> float:
 
 
 def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
-                 fuse=0, fuse_kind=None, pipeline=False):
+                 fuse=0, fuse_kind=None, pipeline=False,
+                 exchange="ppermute"):
     import jax
 
     from mpi_cuda_process_tpu import (
@@ -90,6 +91,8 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
     kernel_kind = None  # which slab-operand kernel carried the rung
     if pipeline and n_dev == 1:
         return None  # no exchange to pipeline on the 1-device rung
+    if exchange == "rdma" and n_dev == 1:
+        return None  # no exchange for the remote-DMA ring to carry
     if n_dev > 1:
         mesh = make_mesh(mesh_shape)
         if fuse > 1:
@@ -106,8 +109,14 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
             step = make_sharded_temporal_step(st, mesh, global_shape, fuse,
                                               kind=fuse_kind,
                                               overlap=overlap,
-                                              pipeline=pipeline)
+                                              pipeline=pipeline,
+                                              exchange=exchange)
             if step is None:
+                return None
+            if exchange == "rdma" and \
+                    getattr(step, "_exchange", None) != "rdma":
+                # a row labeled exchange=rdma must not silently price
+                # the ppermute transport
                 return None
             if overlap and not getattr(step, "_overlap_active", False):
                 # a row labeled overlap=true must not silently price the
@@ -264,6 +273,26 @@ def main(argv=None) -> int:
                         "stamps the pipeline flag, so relative CPU "
                         "evidence and future real-slice rows stay "
                         "distinguishable")
+    p.add_argument("--exchange", default="ppermute",
+                   choices=["ppermute", "rdma"],
+                   help="halo-exchange transport for the --fuse rungs: "
+                        "ppermute (default, XLA collective on HBM slabs) "
+                        "or rdma — the in-kernel remote-DMA ring "
+                        "(ops/pallas/remote.py: boundary slabs through "
+                        "double-buffered VMEM rings via "
+                        "make_async_remote_copy, zero XLA ppermute in "
+                        "the step).  The A/B against the same ladder "
+                        "with --exchange ppermute prices the transport. "
+                        "Needs --fuse; forces --fuse-kind stream (the "
+                        "only rdma host — an explicit different kind "
+                        "errors rather than silently re-labeling); "
+                        "composes with --overlap/--pipeline and "
+                        "--mesh-axes 1|2; 1-device rungs and rungs that "
+                        "cannot host the streaming kernel are skipped, "
+                        "never silently priced as ppermute rows.  Every "
+                        "emitted row stamps the mode, so relative CPU "
+                        "evidence (interpret-emulated) and future "
+                        "real-slice rows stay distinguishable")
     p.add_argument("--fuse", type=int, default=0,
                    help="temporal blocking: k fused micro-steps per "
                         "width-k exchange (weak/strong modes; meshes keep "
@@ -285,6 +314,18 @@ def main(argv=None) -> int:
                         "rungs — run both for the decomposition-shape "
                         "A/B against the same grid")
     a = p.parse_args(argv)
+    if a.exchange == "rdma":
+        # resolved BEFORE the pipeline default below: an rdma ladder
+        # must never be silently re-labeled onto the pad-free kind
+        if not (a.fuse > 1):
+            p.error("--exchange rdma needs --fuse K (the remote-DMA "
+                    "ring feeds the streaming temporal-blocking "
+                    "kernels)")
+        if a.fuse_kind not in (None, "stream"):
+            p.error("--exchange rdma rides the streaming kernel family "
+                    "only; drop --fuse-kind or set it to stream")
+        # pin the kernel class so every rung prices the same kernel
+        a.fuse_kind = "stream"
     if a.pipeline:
         if not (a.fuse > 1):
             p.error("--pipeline needs --fuse K (the slab-carry scan "
@@ -395,16 +436,18 @@ def _ladder(a, p, jax, st, n_devices, _tel) -> int:
         got = bench_config(
             st, mesh_shape, global_shape, a.steps, a.reps,
             overlap=a.overlap, fuse=a.fuse, fuse_kind=a.fuse_kind,
-            pipeline=a.pipeline)
+            pipeline=a.pipeline, exchange=a.exchange)
         if got is None:
             print(f"[scaling] skip {mesh_shape}: untileable fused "
                   f"k={a.fuse}"
                   + (" (or cannot host --pipeline)" if a.pipeline
-                     else ""), file=sys.stderr)
+                     else "")
+                  + (" (or cannot host --exchange rdma)"
+                     if a.exchange == "rdma" else ""), file=sys.stderr)
             _tel("skip", mesh=list(mesh_shape), grid=list(global_shape),
-                 fuse=a.fuse, pipeline=a.pipeline,
+                 fuse=a.fuse, pipeline=a.pipeline, exchange=a.exchange,
                  reason="untileable or cannot host the requested "
-                        "overlap/pipeline/kind contract")
+                        "overlap/pipeline/kind/exchange contract")
             continue
         mcells, per_step, kernel_kind = got
         per_dev = mcells / n_dev
@@ -418,6 +461,7 @@ def _ladder(a, p, jax, st, n_devices, _tel) -> int:
             "overlap": a.overlap, "fuse": a.fuse,
             "pipeline": a.pipeline,
             "fuse_kind": a.fuse_kind,
+            "exchange": a.exchange,
             "kernel_kind": kernel_kind,
             "mesh_axes": a.mesh_axes,
             "mesh": list(mesh_shape), "grid": list(global_shape),

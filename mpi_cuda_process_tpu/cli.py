@@ -181,6 +181,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "into the sliding window); auto = the "
                         "measured default (padfree above the HBM "
                         "threshold, else tiled)")
+    p.add_argument("--exchange", default="ppermute",
+                   choices=["ppermute", "rdma"],
+                   help="halo-exchange transport for sharded --fuse runs: "
+                        "ppermute = XLA collective-permute on HBM slabs "
+                        "(the default every other mode uses); rdma = "
+                        "IN-KERNEL remote DMA (ops/pallas/remote.py): "
+                        "each boundary slab is staged chunk-by-chunk "
+                        "through a double-buffered VMEM ring and pushed "
+                        "into the neighbor's recv ring by "
+                        "make_async_remote_copy under send/recv DMA "
+                        "semaphores (barrier at pass start for neighbor-"
+                        "readiness) — no XLA collective in the step, no "
+                        "HBM slab transient in the budget, exchange "
+                        "latency per-chunk.  Needs --fuse + --mesh + "
+                        "--fuse-kind stream (the streaming kernel family "
+                        "hosts it, both mesh families, f32 and bf16); "
+                        "composes with --overlap and --pipeline; never "
+                        "silently falls back — unsupported combos raise "
+                        "with the reason.  Bit-exact vs ppermute")
     p.add_argument("--mem-check", default="error",
                    choices=["error", "warn", "off"],
                    help="per-device HBM budget guard (TPU runs): estimate "
@@ -203,7 +222,7 @@ def config_from_args(argv=None) -> RunConfig:
         profile=a.profile, telemetry=a.telemetry,
         compute=a.compute, overlap=a.overlap, pipeline=a.pipeline,
         ensemble=a.ensemble,
-        fuse=a.fuse, fuse_kind=a.fuse_kind,
+        fuse=a.fuse, fuse_kind=a.fuse_kind, exchange=a.exchange,
         tol=a.tol, tol_check_every=a.tol_check_every,
         check_finite=a.check_finite, debug_checks=a.debug_checks,
         dump_every=a.dump_every, dump_dir=a.dump_dir,
@@ -303,6 +322,7 @@ def maybe_auto_fuse(cfg: RunConfig) -> RunConfig:
         return cfg
     if (cfg.periodic or cfg.tol > 0 or cfg.debug_checks or cfg.ensemble
             or cfg.overlap or cfg.pipeline or cfg.resume
+            or cfg.exchange != "ppermute"
             or _uses_mesh(cfg) or cfg.mesh):
         return cfg
     cadences = [cfg.iters, cfg.log_every, cfg.checkpoint_every,
@@ -456,6 +476,28 @@ def build(cfg: RunConfig):
         # upgrades into a kernel that was never probed (and silently no-op
         # off-TPU) — require the explicit pairing
         raise ValueError("--fuse-kind requires an explicit --fuse K")
+    if cfg.exchange == "rdma":
+        # a forced exchange mode is never silently ignored (the same
+        # contract as a forced kind): every unsupported combination
+        # raises with the reason BEFORE any build work
+        if not cfg.fuse:
+            raise ValueError(
+                "--exchange rdma requires an explicit --fuse K (the "
+                "in-kernel remote-DMA exchange feeds the streaming "
+                "temporal-blocking kernels)")
+        if not use_mesh:
+            raise ValueError(
+                "--exchange rdma needs --mesh: an unsharded run has no "
+                "halo exchange for the remote-DMA ring to carry")
+        if cfg.fuse_kind != "stream":
+            raise ValueError(
+                "--exchange rdma rides the streaming kernel family: "
+                "force --fuse-kind stream (the VMEM-ring kernels the "
+                "remote DMA feeds) or drop --exchange rdma")
+        if cfg.periodic:
+            raise ValueError(
+                "--exchange rdma is guard-frame only (the streaming "
+                "kernels have no periodic wrap path)")
     if cfg.pipeline and not cfg.fuse:
         # a requested pipeline must never be silently ignored (the
         # forced-flag contract): without temporal blocking there are no
@@ -496,7 +538,8 @@ def build(cfg: RunConfig):
                                                       "padfree") else None
             fused = stepper_lib.make_sharded_temporal_step(
                 st, m, cfg.grid, cfg.fuse, periodic=cfg.periodic,
-                kind=kind, overlap=cfg.overlap, pipeline=cfg.pipeline)
+                kind=kind, overlap=cfg.overlap, pipeline=cfg.pipeline,
+                exchange=cfg.exchange)
             if cfg.overlap and fused is not None and \
                     not getattr(fused, "_overlap_active", False):
                 log.warning(
@@ -511,6 +554,8 @@ def build(cfg: RunConfig):
                     f"--fuse {cfg.fuse} + --mesh {cfg.mesh}"
                     + (f" --fuse-kind {kind}" if kind else "")
                     + (" --pipeline" if cfg.pipeline else "")
+                    + (" --exchange rdma" if cfg.exchange == "rdma"
+                       else "")
                     + f" unsupported for {st.name} on {cfg.grid}: needs a "
                     f"fused kernel, an unsharded lane axis"
                     + (", guard-frame BCs, local z >= 3 chunks of >= "
@@ -712,7 +757,8 @@ def _check_mem_budget(cfg: RunConfig) -> None:
             st, cfg.grid, mesh=cfg.mesh, fuse=cfg.fuse,
             ensemble=cfg.ensemble, periodic=cfg.periodic,
             compute=compute, fuse_kind=cfg.fuse_kind,
-            overlap=cfg.overlap, pipeline=cfg.pipeline)
+            overlap=cfg.overlap, pipeline=cfg.pipeline,
+            exchange=cfg.exchange)
     except ValueError:
         if cfg.mem_check == "error":
             raise
@@ -744,7 +790,7 @@ def _emit_static_cost(cfg: RunConfig, st, session) -> None:
         session.event("costmodel", **costmodel.static_cost(
             st, cfg.grid, mesh=cfg.mesh, fuse=cfg.fuse,
             fuse_kind=cfg.fuse_kind, periodic=cfg.periodic,
-            ensemble=cfg.ensemble))
+            ensemble=cfg.ensemble, exchange=cfg.exchange))
     except Exception:  # noqa: BLE001 — telemetry is never load-bearing
         log.debug("static cost model failed; trace goes without it",
                   exc_info=True)
@@ -781,6 +827,14 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
     st, step_fn, fields, start_step = build(cfg)
     if session is not None:
         _emit_static_cost(cfg, st, session)
+        if cfg.exchange == "rdma":
+            # honest mode tag: which execution path actually carries the
+            # remote-DMA exchange (the compiled Pallas collective kernel,
+            # or the interpret-mode loopback emulation on CPU) — a CPU
+            # run must never read as a measured rdma path
+            session.event(
+                "exchange", mode="rdma",
+                backend=getattr(step_fn, "_rdma_backend", "unknown"))
     remaining = cfg.iters - start_step
     if remaining <= 0:
         log.info("checkpoint already at step %d >= iters", start_step)

@@ -49,6 +49,11 @@ class RunConfig:
     # default): tiled (padded 4-block) | padfree (9-block raw-grid) |
     # stream (sliding-window manual DMA, ops/pallas/streamfused.py)
     fuse_kind: str = "auto"
+    # halo-exchange transport for sharded fused runs: ppermute (XLA
+    # collective on HBM slabs) | rdma (in-kernel remote DMA through VMEM
+    # rings, ops/pallas/remote.py — streaming kind only, never a silent
+    # fallback)
+    exchange: str = "ppermute"
     check_finite: int = 0  # >0: assert all fields finite every N steps
     debug_checks: bool = False  # checkify NaN/bounds checks, step-localized
     tol: float = 0.0  # >0: stop when residual < tol (lax.while_loop runner)

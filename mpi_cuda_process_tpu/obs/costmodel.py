@@ -123,6 +123,41 @@ def hbm_bytes_per_step(stencil, local_shape: Sequence[int],
     return (2 * stencil.num_fields * cells * item) // max(1, int(fuse))
 
 
+def rdma_stats_from_jaxpr(closed) -> Dict[str, int]:
+    """Remote-DMA exchange counters read off a traced program: remote
+    ``dma_start`` eqns (each is one chunk crossing the ICI) and the
+    residual ``ppermute`` count (pinned 0 for an rdma step).  The
+    jaxpr-reality half of the rdma cross-check."""
+    from ..utils.jaxprcheck import count_primitive, count_remote_dma
+
+    return {
+        "remote_dma": count_remote_dma(closed),
+        "ppermute_rounds": count_primitive(closed, "ppermute"),
+    }
+
+
+def _rdma_sites(stencil, local: Sequence[int], m: int,
+                counts: Sequence[int]) -> List[Dict[str, Any]]:
+    """The per-field ring-exchange sites of one slab-kind pass under
+    ``exchange="rdma"``, with their chunk geometry — read from the SAME
+    ``remote.pick_chunks`` the kernel builder uses, so the analytic DMA
+    counts cross-check against the kernel's actual grid by
+    construction.  Mirrors ``halo.exchange_slabs_2axis``: one call per
+    z-slab pair, one per y-slab pair, two per corner set (the two-pass
+    composition exchanges zlo and zhi separately along y)."""
+    from ..ops.pallas.remote import ring_exchange_stats
+
+    lz, ly, lx = local
+    sites = []
+    if counts[0] > 1:
+        sites.append(ring_exchange_stats((m, ly, lx), stencil.dtype))
+    if counts[1] > 1:
+        sites.append(ring_exchange_stats((lz, m, lx), stencil.dtype))
+        corner = ring_exchange_stats((m, m, lx), stencil.dtype)
+        sites += [corner, dict(corner)]
+    return sites
+
+
 def comm_stats(
     stencil,
     grid: Sequence[int],
@@ -130,6 +165,7 @@ def comm_stats(
     fuse: int = 0,
     fuse_kind: str = "auto",
     periodic: bool = False,
+    exchange: str = "ppermute",
 ) -> Optional[Dict[str, Any]]:
     """Analytic ppermute rounds + bytes per device, or None (unsharded).
 
@@ -149,6 +185,21 @@ def comm_stats(
       already padded; the plain step exchanges only fields with a
       nonzero ``field_halo`` at width ``halo``, the fused kinds every
       field at width ``m``.
+
+    ``exchange="rdma"`` (streaming kind): the same slab set crosses the
+    ICI, but as in-kernel remote-DMA chunks instead of ppermutes — the
+    counters become ``rdma_exchange_calls_per_pass`` (ring-kernel
+    invocations: one per z-slab pair, one per y-slab pair, two per
+    corner set) and ``rdma_dma_per_pass`` (remote ``dma_start`` count:
+    2 directions x nchunks per call, chunk geometry from
+    ``remote.pick_chunks`` — the SAME function the kernel builds from,
+    so the count cross-checks against the kernel grid by construction;
+    pinned against traced jaxprs in tests and re-checked per manifest
+    by :func:`rdma_crosscheck`).  ``ppermute_rounds_per_pass`` is 0 by
+    definition (the zero-collective gate), ici bytes are unchanged
+    (the ring carries the same payloads), and ``slab_operand_bytes`` is
+    None — the recv side stages through VMEM rings, so budget has no
+    HBM slab part to compare (see utils/budget.py).
     """
     ndim = stencil.ndim
     counts = (tuple(int(c) for c in mesh) + (1,) * ndim)[:ndim]
@@ -174,15 +225,19 @@ def comm_stats(
                          and fuse_kind in ("padfree", "stream")) \
         else ("padded" if fuse else "plain")
 
+    rdma = exchange == "rdma" and kind == "stream"
     rounds = 0
     ici = 0
     operand: Optional[int] = None
+    rdma_sites: Optional[List[Dict[str, Any]]] = None
     if kind in ("padfree", "stream"):
         lz, ly, lx = local
         m = widths[0]
         two_axis = counts[1] > 1
         z_sharded = counts[0] > 1
         z_bytes = m * ly * lx * item
+        if rdma:
+            rdma_sites = _rdma_sites(stencil, local, m, counts)
         if z_sharded:
             rounds += nf * 2
             ici += nf * 2 * z_bytes
@@ -224,15 +279,73 @@ def comm_stats(
                 rounds += 2
                 ici += 2 * slab_cells * item
 
-    return {
+    out: Dict[str, Any] = {
         "kind": kind,
+        "exchange": "rdma" if rdma else "ppermute",
         "per_pass_steps": per_pass_steps,
         "width_m": max(widths),
         "sharded_counts": list(counts),
-        "ppermute_rounds_per_pass": rounds,
+        "ppermute_rounds_per_pass": 0 if rdma else rounds,
         "ici_bytes_per_pass": ici,
         "ici_bytes_per_step": ici / per_pass_steps,
-        "slab_operand_bytes": operand,
+        "slab_operand_bytes": None if rdma else operand,
+    }
+    if rdma:
+        # one ring-kernel invocation per site PER FIELD; the DMA count
+        # is what a traced step must reproduce exactly
+        out["rdma_exchange_calls_per_pass"] = nf * len(rdma_sites)
+        out["rdma_dma_per_pass"] = nf * sum(
+            s["remote_dma_per_call"] for s in rdma_sites)
+        out["rdma_chunks"] = rdma_sites
+    return out
+
+
+def rdma_crosscheck(
+    stencil,
+    grid: Sequence[int],
+    mesh: Sequence[int],
+    fuse: int,
+    periodic: bool = False,
+) -> Optional[Dict[str, Any]]:
+    """Analytic rdma DMA count vs a TRACED compiled rdma step.
+
+    The rdma analogue of :func:`budget_crosscheck`: the analytic chunk
+    model (``remote.pick_chunks``) against the remote ``dma_start``
+    count of the actual step jaxpr (``interpret=False`` — the kernel a
+    TPU run compiles; tracing is shape-level, nothing executes), plus
+    the zero-ppermute pin.  Returns None when this box cannot host the
+    mesh (config 5's 64-chip population) — the analytic side still
+    rides the manifest via ``comm["rdma_dma_per_pass"]``; tests pin the
+    match on traceable meshes.
+    """
+    cs = comm_stats(stencil, grid, mesh, fuse=fuse, fuse_kind="stream",
+                    periodic=periodic, exchange="rdma")
+    if cs is None or "rdma_dma_per_pass" not in cs:
+        return None
+    try:
+        from ..parallel.mesh import make_mesh
+        from ..parallel.stepper import make_sharded_fused_step
+
+        mesh_obj = make_mesh(tuple(mesh))
+        step = make_sharded_fused_step(
+            stencil, mesh_obj, tuple(int(g) for g in grid), int(fuse),
+            interpret=False, kind="stream", periodic=periodic,
+            exchange="rdma")
+        if step is None:
+            return None
+        abstract = tuple(
+            jax.ShapeDtypeStruct(tuple(int(g) for g in grid),
+                                 stencil.dtype)
+            for _ in range(stencil.num_fields))
+        traced = rdma_stats_from_jaxpr(jax.make_jaxpr(step)(abstract))
+    except Exception:  # noqa: BLE001 — mesh too big for this box, or
+        return None    # any trace-environment limitation: no cross-check
+    return {
+        "analytic_remote_dma": cs["rdma_dma_per_pass"],
+        "traced_remote_dma": traced["remote_dma"],
+        "traced_ppermute": traced["ppermute_rounds"],
+        "match": (traced["remote_dma"] == cs["rdma_dma_per_pass"]
+                  and traced["ppermute_rounds"] == 0),
     }
 
 
@@ -282,6 +395,7 @@ def static_cost(
     ensemble: int = 0,
     hbm_gbs: float = V5E_HBM_GBS,
     ici_gbs: float = V5E_ICI_GBS,
+    exchange: str = "ppermute",
 ) -> Dict[str, Any]:
     """The manifest's static cost block: counters + roofline prediction.
 
@@ -296,7 +410,7 @@ def static_cost(
     local = _local_shape(grid, mesh)
     batch = max(1, int(ensemble))
     comm = comm_stats(stencil, grid, mesh, fuse=fuse, fuse_kind=fuse_kind,
-                      periodic=periodic)
+                      periodic=periodic, exchange=exchange)
     flops = batch * step_flops(stencil, local, periodic=periodic)
     hbm_b = hbm_bytes_per_step(stencil, local, fuse=fuse, batch=batch)
     t_hbm_ms = hbm_b / (hbm_gbs * 1e9) * 1e3
@@ -338,4 +452,13 @@ def static_cost(
                 stencil, grid, mesh, fuse, fuse_kind, periodic=periodic)
         except Exception:  # noqa: BLE001 — the cross-check must never
             out["budget_crosscheck"] = None  # block a manifest write
+    if comm and comm.get("exchange") == "rdma":
+        try:
+            # traced remote-DMA count vs the analytic chunk model —
+            # rides every rdma manifest so obs_report attributes the
+            # in-kernel traffic (None when this box can't host the mesh)
+            out["rdma_crosscheck"] = rdma_crosscheck(
+                stencil, grid, mesh, fuse, periodic=periodic)
+        except Exception:  # noqa: BLE001 — never block a manifest write
+            out["rdma_crosscheck"] = None
     return out

@@ -85,15 +85,23 @@ def key_id(key: Dict[str, Any]) -> str:
 
 
 def baseline_key(row: Dict[str, Any]) -> str:
-    """Baseline identity for the gate: same label on the same backend.
+    """Baseline identity for the gate: same label on the same backend
+    under the same EXCHANGE MODE.
 
     Deliberately coarser than :func:`key_id`: a BUILDER_REV bump or a
     flag change must still be COMPARED against the old number (that
     comparison is the regression gate's whole job), but a CPU smoke
-    must never be judged against a TPU baseline.
+    must never be judged against a TPU baseline — and a ppermute
+    measurement must never be the baseline an rdma run is scored
+    against (the transports are different execution paths; a label that
+    exists in the ledger only under the other mode is NO_BASELINE, not
+    REGRESSED).  The mode rides the flags only when non-default, so
+    every pre-exchange row keeps its historical baseline key.
     """
     k = row["key"]
-    return f"{k['label']}|{k.get('backend')}"
+    mode = (k.get("flags") or {}).get("exchange")
+    tail = f"|{mode}" if mode else ""
+    return f"{k['label']}|{k.get('backend')}{tail}"
 
 
 def classify(value: Any, *, stale: bool = False, suspect: bool = False,
@@ -259,9 +267,15 @@ def append_rows(rows: Iterable[Dict[str, Any]],
 # ------------------------------------------------- telemetry ingestion
 
 def _flags(run: Dict[str, Any]) -> Dict[str, Any]:
-    return {k: run.get(k) for k in ("fuse", "fuse_kind", "overlap",
-                                    "pipeline")
-            if run.get(k)}
+    out = {k: run.get(k) for k in ("fuse", "fuse_kind", "overlap",
+                                   "pipeline")
+           if run.get(k)}
+    # exchange mode is part of the row identity AND the baseline key
+    # (see baseline_key) — recorded only when non-default so every
+    # pre-existing key (and its best_known dedupe) stays byte-identical
+    if run.get("exchange") and run["exchange"] != "ppermute":
+        out["exchange"] = run["exchange"]
+    return out
 
 
 def _cli_label(run: Dict[str, Any]) -> str:
@@ -279,6 +293,8 @@ def _cli_label(run: Dict[str, Any]) -> str:
         parts.append("overlap")
     if run.get("pipeline"):
         parts.append("pipeline")
+    if run.get("exchange") and run["exchange"] != "ppermute":
+        parts.append(str(run["exchange"]))
     return "cli_" + "_".join(p for p in parts if p)
 
 
@@ -295,6 +311,8 @@ def _scaling_label(run: Dict[str, Any], rung: Dict[str, Any]) -> str:
         parts.append("overlap")
     if rung.get("pipeline"):
         parts.append("pipeline")
+    if rung.get("exchange") and rung["exchange"] != "ppermute":
+        parts.append(str(rung["exchange"]))
     return "_".join(parts)
 
 
@@ -420,8 +438,15 @@ def rows_from_log(log_path: str) -> List[Dict[str, Any]]:
                 provenance=_prov_subset(prov),
                 grid=e.get("grid"), mesh=e.get("mesh"),
                 kind=e.get("kernel_kind") or e.get("fuse_kind"),
-                flags={k: e.get(k) for k in ("fuse", "overlap",
-                                             "pipeline") if e.get(k)},
+                # the historical flag set PLUS exchange-when-non-default:
+                # re-ingesting an old log must reproduce its old key_id
+                # byte-for-byte (idempotent append), so the set is only
+                # ever extended by fields old logs never carried
+                flags={**{k: e.get(k) for k in ("fuse", "overlap",
+                                                "pipeline") if e.get(k)},
+                       **({"exchange": e["exchange"]}
+                          if e.get("exchange")
+                          and e["exchange"] != "ppermute" else {})},
                 builder_rev=prov.get("builder_rev"),
                 unit=("Mcells/s" if e.get("mcells_per_s") is not None
                       else "ms/step")))

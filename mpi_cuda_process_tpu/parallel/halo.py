@@ -16,6 +16,13 @@ stencil's guard-cell constant for the missing neighbor slab.
 Corner/edge halos (needed by 27-point footprints) come from the two-pass
 axis-wise scheme (SURVEY.md §7.3.2): exchanging axis d AFTER axes < d have
 been padded transports corner data with face-only transfers.
+
+The slab exchanges optionally route through :class:`RdmaTransport`
+instead of ``ppermute``: the in-kernel remote-DMA exchange
+(``ops/pallas/remote.py``) — device-initiated, chunked through VMEM
+rings, zero XLA collectives.  Neighbor ids resolve axis-wise on z-only,
+y-only, and 2-axis meshes (:func:`neighbor_logical_ids`); corners keep
+the two-pass composition, so no diagonal transfer exists on any path.
 """
 
 from __future__ import annotations
@@ -33,6 +40,127 @@ def _take(x: jax.Array, axis: int, start: int, size: int) -> jax.Array:
     return x[tuple(idx)]
 
 
+# ---------------------------------------------------------------------------
+# Remote-DMA transport: the in-kernel replacement for lax.ppermute.
+# ---------------------------------------------------------------------------
+
+def neighbor_logical_ids(mesh, axis_name: str, shift: int) -> jax.Array:
+    """LOGICAL device id of this shard's ring neighbor, as a traced int32.
+
+    Neighbor-id resolution for z-only, y-only, AND 2-axis meshes in one
+    place: the logical id is the row-major linearization of the mesh
+    coordinates (exactly how ``parallel/mesh.make_mesh`` lays devices
+    out), with THIS axis's index shifted by ``shift`` mod its size and
+    every other axis held at this shard's own index — so a z-exchange
+    on an (8, 8, 1) mesh targets the same-column neighbor, never a
+    diagonal (corners ride the existing two-pass axis-wise
+    composition, exactly like the ppermute path).
+    """
+    lid = jnp.int32(0)
+    for name in mesh.axis_names:
+        size = int(mesh.shape[name])
+        idx = lax.axis_index(name)
+        if name == axis_name:
+            idx = (idx + shift) % size
+        lid = lid * size + idx
+    return lid.astype(jnp.int32)
+
+
+class RdmaTransport:
+    """Per-step transport object for ``exchange="rdma"``.
+
+    Built once per stepper construction; every ``exchange_slabs_*`` call
+    that receives it routes its ring shifts through the in-kernel
+    remote-DMA exchange (``ops/pallas/remote.py``) instead of
+    ``lax.ppermute``.  The transport owns the per-program
+    ``collective_id`` allocation (each exchange site gets a distinct
+    barrier id — two concurrently-scheduled collective kernels must
+    never share one) and records per-site chunk geometry in ``sites``
+    for the costmodel/grid cross-checks.
+
+    ``backend`` is the honest mode tag telemetry carries:
+    ``"pallas-rdma"`` when the remote kernel runs, ``"interpret-
+    emulated"`` when the loopback kernel + ``all_gather`` ring shift
+    stands in (see ``ops/pallas/compat.interpret_remote_dma_supported``).
+    """
+
+    def __init__(self, mesh, interpret: bool):
+        from ..ops.pallas.compat import interpret_remote_dma_supported
+
+        self.mesh = mesh
+        self.interpret = bool(interpret)
+        self.emulate = self.interpret and not interpret_remote_dma_supported()
+        self.backend = "interpret-emulated" if self.emulate else "pallas-rdma"
+        self.sites = []  # chunk-geometry meta per built exchange site
+        self._next_collective_id = 0
+
+    def _collective_id(self) -> int:
+        cid = self._next_collective_id
+        self._next_collective_id += 1
+        return cid
+
+    def shift_pair(self, hi_slab: jax.Array, lo_slab: jax.Array,
+                   axis_name: str) -> Tuple[jax.Array, jax.Array]:
+        """Full-ring shift of a slab pair along ``axis_name``:
+        ``(from_left, from_right)`` — the previous shard's ``hi_slab``
+        and the next shard's ``lo_slab`` (wrap at the ring ends; the
+        caller substitutes the bc constant at non-periodic walls, the
+        same contract as the truncated-ppermute path)."""
+        from ..ops.pallas.remote import build_ring_exchange_call
+
+        n = int(self.mesh.shape[axis_name])
+        if self.emulate:
+            call, meta = build_ring_exchange_call(
+                hi_slab.shape, hi_slab.dtype, remote=False,
+                interpret=True)
+            self.sites.append(meta)
+            # the loopback kernel runs the full VMEM-ring machinery;
+            # the cross-chip hop is the explicit gather-shift below
+            # (zero ppermute — the upstream discharge rule's own
+            # emulation, restricted to one named axis at a time)
+            wire_hi, wire_lo = call(hi_slab, lo_slab)
+            g_hi = lax.all_gather(wire_hi, axis_name)
+            g_lo = lax.all_gather(wire_lo, axis_name)
+            i = lax.axis_index(axis_name)
+            from_left = lax.dynamic_index_in_dim(
+                g_hi, (i - 1) % n, 0, keepdims=False)
+            from_right = lax.dynamic_index_in_dim(
+                g_lo, (i + 1) % n, 0, keepdims=False)
+            return from_left, from_right
+        call, meta = build_ring_exchange_call(
+            hi_slab.shape, hi_slab.dtype, remote=True,
+            interpret=self.interpret,
+            collective_id=self._collective_id())
+        self.sites.append(meta)
+        nbr = jnp.stack([neighbor_logical_ids(self.mesh, axis_name, +1),
+                         neighbor_logical_ids(self.mesh, axis_name, -1)])
+        return call(nbr, hi_slab, lo_slab)
+
+
+def _ring_shift_pair(hi_slab, lo_slab, axis_name, n_shards, periodic,
+                     transport):
+    """The collective core every slab exchange shares: shift ``hi_slab``
+    down-ring and ``lo_slab`` up-ring, via ``lax.ppermute`` (default) or
+    the in-kernel remote-DMA transport.  The rdma ring is always FULL
+    (uniform SPMD — every device sends both directions); the ppermute
+    path truncates at non-periodic walls instead.  Either way the wall
+    shards' received values are don't-care: the caller overwrites them
+    with the guard-cell constant, so the two transports are bit-exact.
+    """
+    if transport is not None:
+        return transport.shift_pair(hi_slab, lo_slab, axis_name)
+    # Downward shift: shard i's hi_slab -> shard i+1's left halo.
+    down = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    # Upward shift: shard i's lo_slab -> shard i-1's right halo.
+    up = [(i, (i - 1) % n_shards) for i in range(n_shards)]
+    if not periodic:
+        down = down[:-1]
+        up = up[1:]
+    from_left = lax.ppermute(hi_slab, axis_name, down)
+    from_right = lax.ppermute(lo_slab, axis_name, up)
+    return from_left, from_right
+
+
 def exchange_slabs_axis(
     x: jax.Array,
     axis: int,
@@ -41,16 +169,18 @@ def exchange_slabs_axis(
     halo: int,
     bc_value,
     periodic: bool = False,
+    transport: Optional[RdmaTransport] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """The two halo slabs for ``axis``, UNconcatenated: ``(left, right)``.
 
     ``left`` is what belongs just before this shard's rows (the lower
     neighbor's last ``halo`` rows), ``right`` just after.  Interior faces
-    receive the neighbor's border slab (ppermute); global faces receive
-    ``bc_value`` (or wrap when ``periodic``).  Callers that need the
-    classic padded block concatenate (``exchange_pad_axis``); the pad-free
-    sharded kernels hand the slabs to the kernel as separate operands so
-    no padded copy of the block is ever materialized.
+    receive the neighbor's border slab (ppermute, or the in-kernel
+    remote-DMA exchange when ``transport`` is given); global faces
+    receive ``bc_value`` (or wrap when ``periodic``).  Callers that need
+    the classic padded block concatenate (``exchange_pad_axis``); the
+    pad-free sharded kernels hand the slabs to the kernel as separate
+    operands so no padded copy of the block is ever materialized.
     """
     hi_slab = _take(x, axis, x.shape[axis] - halo, halo)  # my last rows
     lo_slab = _take(x, axis, 0, halo)  # my first rows
@@ -64,19 +194,13 @@ def exchange_slabs_axis(
         left = jnp.full(shape, bc, x.dtype)
         return left, left
 
-    # Downward shift: shard i's hi_slab -> shard i+1's left halo.
-    down = [(i, (i + 1) % n_shards) for i in range(n_shards)]
-    # Upward shift: shard i's lo_slab -> shard i-1's right halo.
-    up = [(i, (i - 1) % n_shards) for i in range(n_shards)]
-    if not periodic:
-        down = down[:-1]
-        up = up[1:]
-    from_left = lax.ppermute(hi_slab, axis_name, down)
-    from_right = lax.ppermute(lo_slab, axis_name, up)
+    from_left, from_right = _ring_shift_pair(
+        hi_slab, lo_slab, axis_name, n_shards, periodic, transport)
 
     if not periodic:
-        # Edge shards got zeros from the truncated permutation; substitute the
-        # guard-cell constant (the reference's pinned frame value).
+        # Edge shards got zeros (truncated ppermute) or wrap values
+        # (full rdma ring); substitute the guard-cell constant either
+        # way (the reference's pinned frame value).
         idx = lax.axis_index(axis_name)
         bc = jnp.asarray(bc_value, x.dtype)
         from_left = jnp.where(idx == 0, bc, from_left)
@@ -94,6 +218,7 @@ def exchange_slabs_from_borders(
     halo: int,
     bc_value,
     periodic: bool = False,
+    transport: Optional[RdmaTransport] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """``exchange_slabs_axis`` with the SENDER-side border slabs supplied
     directly instead of sliced from the block.
@@ -116,13 +241,8 @@ def exchange_slabs_from_borders(
         left = jnp.full(lo_rows.shape, bc, lo_rows.dtype)
         return left, left
 
-    down = [(i, (i + 1) % n_shards) for i in range(n_shards)]
-    up = [(i, (i - 1) % n_shards) for i in range(n_shards)]
-    if not periodic:
-        down = down[:-1]
-        up = up[1:]
-    from_left = lax.ppermute(hi_rows, axis_name, down)
-    from_right = lax.ppermute(lo_rows, axis_name, up)
+    from_left, from_right = _ring_shift_pair(
+        hi_rows, lo_rows, axis_name, n_shards, periodic, transport)
 
     if not periodic:
         idx = lax.axis_index(axis_name)
@@ -140,6 +260,7 @@ def exchange_slabs_2axis(
     halo: int,
     bc_value,
     periodic: bool = False,
+    transport: Optional[RdmaTransport] = None,
 ) -> Tuple[Tuple[jax.Array, jax.Array],
            Tuple[jax.Array, jax.Array],
            Tuple[jax.Array, jax.Array, jax.Array, jax.Array]]:
@@ -162,13 +283,17 @@ def exchange_slabs_2axis(
     hl = (z-hi, y-lo), hh = (z-hi, y-hi).
     """
     zlo, zhi = exchange_slabs_axis(
-        x, 0, axis_names[0], shard_counts[0], halo, bc_value, periodic)
+        x, 0, axis_names[0], shard_counts[0], halo, bc_value, periodic,
+        transport=transport)
     ylo, yhi = exchange_slabs_axis(
-        x, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic)
+        x, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic,
+        transport=transport)
     c_ll, c_lh = exchange_slabs_axis(
-        zlo, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic)
+        zlo, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic,
+        transport=transport)
     c_hl, c_hh = exchange_slabs_axis(
-        zhi, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic)
+        zhi, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic,
+        transport=transport)
     return (zlo, zhi), (ylo, yhi), (c_ll, c_lh, c_hl, c_hh)
 
 
@@ -182,6 +307,7 @@ def exchange_slabs_2axis_from_borders(
     halo: int,
     bc_value,
     periodic: bool = False,
+    transport: Optional[RdmaTransport] = None,
 ) -> Tuple[Tuple[jax.Array, jax.Array],
            Tuple[jax.Array, jax.Array],
            Tuple[jax.Array, jax.Array, jax.Array, jax.Array]]:
@@ -199,14 +325,16 @@ def exchange_slabs_2axis_from_borders(
     """
     zlo, zhi = exchange_slabs_from_borders(
         z_lo, z_hi, 0, axis_names[0], shard_counts[0], halo, bc_value,
-        periodic)
+        periodic, transport=transport)
     ylo, yhi = exchange_slabs_from_borders(
         y_lo, y_hi, 1, axis_names[1], shard_counts[1], halo, bc_value,
-        periodic)
+        periodic, transport=transport)
     c_ll, c_lh = exchange_slabs_axis(
-        zlo, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic)
+        zlo, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic,
+        transport=transport)
     c_hl, c_hh = exchange_slabs_axis(
-        zhi, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic)
+        zhi, 1, axis_names[1], shard_counts[1], halo, bc_value, periodic,
+        transport=transport)
     return (zlo, zhi), (ylo, yhi), (c_ll, c_lh, c_hl, c_hh)
 
 

@@ -103,6 +103,19 @@ def _attach_pipeline(stepper, prologue, body, interior_step=None):
     return stepper
 
 
+def _attach_exchange(step, exchange, transport):
+    """Record which exchange transport carries a sharded fused step
+    (``_exchange``: 'ppermute' | 'rdma') and, for rdma, the honest
+    backend tag (``_rdma_backend``: 'pallas-rdma' | 'interpret-
+    emulated') plus the transport itself (``_rdma_transport``, whose
+    per-site chunk geometry the costmodel cross-checks read)."""
+    step._exchange = exchange
+    if transport is not None:
+        step._rdma_backend = transport.backend
+        step._rdma_transport = transport
+    return step
+
+
 def _attach_overlap(step, interior_step):
     """Wrap a shard_map'd overlap step so tests/tools can reach the
     interior-only computation (``_interior_step``) and detect that the
@@ -286,6 +299,7 @@ def make_sharded_fused_step(
     kind: Optional[str] = None,
     overlap: bool = False,
     pipeline: bool = False,
+    exchange: Optional[str] = None,
 ):
     """Temporal blocking under domain decomposition: k steps per exchange.
 
@@ -369,6 +383,24 @@ def make_sharded_fused_step(
     (the interior's exact dependency path, for jaxpr inspection) when
     the split is live.
 
+    ``exchange="rdma"`` replaces every XLA-level ``ppermute`` of the
+    exchange with the IN-KERNEL remote-DMA ring exchange
+    (``ops/pallas/remote.py`` via ``halo.RdmaTransport``): each slab is
+    staged chunk-by-chunk through a double-buffered VMEM ring and
+    pushed into the neighbor's recv ring by ``make_async_remote_copy``
+    under send/recv DMA semaphores, with a barrier semaphore at pass
+    start for neighbor-readiness — exchange latency becomes per-chunk,
+    no XLA collective exists in the step (gated by
+    ``utils/jaxprcheck.assert_rdma_step_structure``), and the budget
+    model drops the HBM slab-transient terms.  Hosted by the streaming
+    kernel family only (``kind="stream"``, z-only AND 2-axis meshes,
+    f32 and bf16); it COMPOSES with ``overlap=True`` and
+    ``pipeline=True``; a forced mode never silently falls back — other
+    kinds, periodic wrap, and 2D grids raise with the reason.  Values
+    are bit-exact vs the ppermute schedule (the ring carries the same
+    bytes; equivalence pinned in interpret mode by
+    tests/test_rdma_exchange.py).
+
     ``pipeline=True`` selects the CROSS-PASS pipelined exchange — the
     slab-carry scan: instead of issuing each pass's width-``m`` exchange
     at pass start (where only that pass's own interior can hide it), the
@@ -407,6 +439,25 @@ def make_sharded_fused_step(
         # auto-selected kernel under the wrong label
         raise ValueError(f"unknown sharded fused kind {kind!r} "
                          "(None=auto, 'stream', 'padfree')")
+    exchange = exchange or "ppermute"
+    if exchange not in ("ppermute", "rdma"):
+        # same contract as a typo'd kind: never measure the default
+        # transport under an unknown exchange label
+        raise ValueError(f"unknown exchange mode {exchange!r} "
+                         "('ppermute' or 'rdma')")
+    if exchange == "rdma":
+        # a forced exchange mode never silently falls back
+        if periodic:
+            raise ValueError(
+                "exchange='rdma' is guard-frame only (the streaming "
+                "kernels that host it have no periodic wrap path) — "
+                "drop --periodic or use --exchange ppermute")
+        if kind != "stream":
+            raise ValueError(
+                "exchange='rdma' rides the streaming kernel family "
+                "(the VMEM-ring kernels the remote DMA feeds): force "
+                "--fuse-kind stream, or use --exchange ppermute for "
+                f"kind={kind!r}")
     if pipeline and periodic:
         # A requested pipeline must never silently fall back (the forced-
         # kind contract): periodic cannot host the slab-carry scan — the
@@ -445,11 +496,11 @@ def make_sharded_fused_step(
             return _make_yzslab_padfree_step(
                 stencil, mesh, global_shape, local_shape, axis_names,
                 counts, k, interpret, periodic, overlap=overlap,
-                stream=True, pipeline=pipeline)
+                stream=True, pipeline=pipeline, exchange=exchange)
         return _make_zslab_padfree_step(
             stencil, mesh, global_shape, local_shape, axis_names, counts,
             k, build_stream_sharded_call, (1, 1), interpret, periodic,
-            overlap=overlap, pipeline=pipeline)
+            overlap=overlap, pipeline=pipeline, exchange=exchange)
     forced_padfree = kind == "padfree"
     if forced_padfree:
         padfree = True
@@ -633,7 +684,7 @@ def make_sharded_fused_step(
 def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
                              axis_names, counts, k, build_call, layout,
                              interpret, periodic, overlap=False,
-                             pipeline=False):
+                             pipeline=False, exchange="ppermute"):
     """shard_map wrapper for the z-slab pad-free fused kernels: width-m
     slab exchange (no concatenation, no padded copy), slabs handed to the
     kernel as operands, frame from SMEM origin scalars.  ``layout`` is
@@ -674,6 +725,15 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
                  (1, 1): "stream"}[layout]
     spec = grid_partition_spec(3, mesh)
 
+    transport = None
+    if exchange == "rdma":
+        from ..ops.pallas.kernels import _interpret_default
+        from .halo import RdmaTransport
+
+        transport = RdmaTransport(
+            mesh, _interpret_default() if interpret is None
+            else bool(interpret))
+
     shells = None
     if overlap and counts[0] > 1:
         from ..ops.pallas.fused import build_overlap_shell_calls
@@ -693,7 +753,8 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
         args = []
         for f, bc in zip(fields, stencil.bc_value):
             lo, hi = exchange_slabs_axis(
-                f, 0, axis_names[0], counts[0], m, bc, periodic=periodic)
+                f, 0, axis_names[0], counts[0], m, bc, periodic=periodic,
+                transport=transport)
             args += [f] * n_core + [lo] * n_slab + [hi] * n_slab
         return tuple(call(_origins(), *args))
 
@@ -706,7 +767,7 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
             check_vma=False,
         )
         step._padfree_kind = kind_name
-        return step
+        return _attach_exchange(step, exchange, transport)
 
     Lz = local_shape[0]
     w = 2 * m
@@ -737,7 +798,8 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
             with jax.named_scope("pipeline_prologue_exchange"):
                 return tuple(
                     exchange_slabs_axis(f, 0, axis_names[0], counts[0],
-                                        m, bc, periodic=periodic)
+                                        m, bc, periodic=periodic,
+                                        transport=transport)
                     for f, bc in zip(fields, stencil.bc_value))
 
         if shells is None:
@@ -750,7 +812,8 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
                     new_slabs = tuple(
                         exchange_slabs_axis(o, 0, axis_names[0],
                                             counts[0], m, bc,
-                                            periodic=periodic)
+                                            periodic=periodic,
+                                            transport=transport)
                         for o, bc in zip(out, stencil.bc_value))
                 return out, new_slabs
         else:
@@ -794,7 +857,7 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
                         exchange_slabs_from_borders(
                             lo_out[i][:m], hi_out[i][w - m:], 0,
                             axis_names[0], counts[0], m, bc,
-                            periodic=periodic)
+                            periodic=periodic, transport=transport)
                         for i, bc in enumerate(stencil.bc_value))
                 return tuple(out), new_slabs
 
@@ -815,7 +878,7 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
         step = _attach_pipeline(stepper, prologue_sm, body_sm,
                                 interior_step=interior_sm)
         step._padfree_kind = kind_name
-        return step
+        return _attach_exchange(step, exchange, transport)
 
     def local_step_overlap(fields: Fields) -> Fields:
         from .halo import exchange_pad_axis, exchange_slabs_axis
@@ -823,7 +886,8 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
         with jax.named_scope("halo_exchange"):
             slabs = [
                 exchange_slabs_axis(f, 0, axis_names[0], counts[0], m, bc,
-                                    periodic=periodic)
+                                    periodic=periodic,
+                                    transport=transport)
                 for f, bc in zip(fields, stencil.bc_value)
             ]
         with jax.named_scope("interior_update"):
@@ -863,13 +927,13 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
                   out_specs=spec, check_vma=False),
     )
     step._padfree_kind = kind_name
-    return step
+    return _attach_exchange(step, exchange, transport)
 
 
 def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
                               axis_names, counts, k, interpret, periodic,
                               overlap=False, stream=False,
-                              pipeline=False):
+                              pipeline=False, exchange="ppermute"):
     """shard_map wrapper for the 2-AXIS pad-free fused kernels
     (y-sharded and y+z-sharded meshes): width-m slab exchange on both
     wall axes plus the four corner pieces by two-pass composition
@@ -946,6 +1010,15 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
     counts2 = (counts[0], counts[1])
     sharded_axes = [d for d in (0, 1) if counts[d] > 1]
 
+    transport = None
+    if exchange == "rdma":
+        from ..ops.pallas.kernels import _interpret_default
+        from .halo import RdmaTransport
+
+        transport = RdmaTransport(
+            mesh, _interpret_default() if interpret is None
+            else bool(interpret))
+
     shells = None
     if overlap and sharded_axes:
         from ..ops.pallas.fused import build_overlap_shell_calls
@@ -970,8 +1043,12 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
     def _exchange(fields, names):
         from .halo import exchange_slabs_2axis
 
+        # (the interior-dummy call passes names (None, None): the
+        # transport is then never consulted — the unsharded path is a
+        # local bc fill on both axes)
         return [exchange_slabs_2axis(f, names, counts2, m, bc,
-                                     periodic=periodic)
+                                     periodic=periodic,
+                                     transport=transport)
                 for f, bc in zip(fields, stencil.bc_value)]
 
     def _kernel_args(fields, ex):
@@ -1001,7 +1078,7 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
             check_vma=False,
         )
         step._padfree_kind = kind_name
-        return step
+        return _attach_exchange(step, exchange, transport)
 
     Lz, Ly = local_shape[0], local_shape[1]
     w = 2 * m
@@ -1106,7 +1183,8 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
                         new_slabs.append(
                             exchange_slabs_2axis_from_borders(
                                 z_lo, z_hi, y_lo, y_hi, names2, counts2,
-                                m, bc, periodic=periodic))
+                                m, bc, periodic=periodic,
+                                transport=transport))
                 return tuple(out), tuple(new_slabs)
 
         prologue_sm = shard_map(local_prologue, mesh=mesh,
@@ -1126,7 +1204,7 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
         step = _attach_pipeline(stepper, prologue_sm, body_sm,
                                 interior_step=interior_sm)
         step._padfree_kind = kind_name
-        return step
+        return _attach_exchange(step, exchange, transport)
 
     def local_step_overlap(fields: Fields) -> Fields:
         with jax.named_scope("halo_exchange"):
@@ -1160,7 +1238,7 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
                   out_specs=spec, check_vma=False),
     )
     step._padfree_kind = kind_name
-    return step
+    return _attach_exchange(step, exchange, transport)
 
 
 def make_sharded_fullgrid_step(
@@ -1329,6 +1407,7 @@ def make_sharded_temporal_step(
     kind: Optional[str] = None,
     overlap: bool = False,
     pipeline: bool = False,
+    exchange: Optional[str] = None,
 ):
     """Temporal blocking under decomposition, any dimensionality.
 
@@ -1350,6 +1429,10 @@ def make_sharded_temporal_step(
     cross-pass slab-carry scan — a requested pipeline never silently
     falls back: unsupported hosts (2D, periodic, the padded kind)
     raise with the reason.
+    ``exchange="rdma"`` (3D streaming kind only) replaces the
+    ``ppermute`` exchange with the in-kernel remote-DMA ring — the
+    same never-silently-falls-back contract: 2D grids, non-stream
+    kinds, and periodic wrap raise with the reason.
     """
     if stencil.ndim == 2:
         if pipeline:
@@ -1357,10 +1440,16 @@ def make_sharded_temporal_step(
                 "pipeline=True is 3D-only: the 2D whole-local-block "
                 "stepper has no slab-operand kind to carry the scan — "
                 "drop --pipeline for 2D grids")
+        if exchange and exchange != "ppermute":
+            raise ValueError(
+                "exchange='rdma' is 3D-only: the 2D whole-local-block "
+                "stepper has no slab-operand streaming kind for the "
+                "remote-DMA ring to feed — drop --exchange rdma for "
+                "2D grids")
         return None if kind else make_sharded_fullgrid_step(
             stencil, mesh, global_shape, k, interpret=interpret,
             periodic=periodic, overlap=overlap)
     return make_sharded_fused_step(
         stencil, mesh, global_shape, k, interpret=interpret,
         periodic=periodic, kind=kind, overlap=overlap,
-        pipeline=pipeline)
+        pipeline=pipeline, exchange=exchange)

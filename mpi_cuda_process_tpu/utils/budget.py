@@ -64,6 +64,7 @@ def estimate_run_bytes(
     fuse_kind: str = "auto",
     overlap: bool = False,
     pipeline: bool = False,
+    exchange: str = "ppermute",
 ) -> Tuple[int, List[Tuple[str, int]]]:
     """Peak per-device live bytes for a run, with a labeled breakdown.
 
@@ -72,6 +73,18 @@ def estimate_run_bytes(
     SMEM-origin frame) variants, the raw whole-step kernels (no
     transient: the state is its own halo), and the jnp pad -> update
     path.  Returns ``(total, [(label, bytes), ...])``.
+
+    ``exchange="rdma"`` (streaming kind under a mesh only — every other
+    combination refuses before allocating, and the estimate says so):
+    the in-kernel remote-DMA exchange stages each boundary slab
+    chunk-by-chunk through double-buffered VMEM rings
+    (``ops/pallas/remote.py``), so the HBM slab-transient term AND the
+    pipelined carried-slab term are DELETED from the breakdown — the
+    exchange path's live set is a few chunk-sized VMEM slots (never
+    HBM-resident full-field or slab-set buffers), absorbed by the
+    workspace-overhead fraction like every other kernel's staging
+    copies.  This is the model change the rdma mode exists for: the
+    last slab copies leave the budget.
     """
     itemsize = jnp.dtype(stencil.dtype).itemsize
     nfields = stencil.num_fields
@@ -91,6 +104,14 @@ def estimate_run_bytes(
     ]
 
     sharded = bool(mesh) and math.prod(mesh) > 1
+    if exchange == "rdma" and not (sharded and fuse and len(local) == 3
+                                   and fuse_kind == "stream"):
+        # the estimate must describe the path the run actually takes:
+        # off the sharded streaming kind, cli/stepper raise before any
+        # allocation — never price a transport the run would refuse
+        parts.append(("rdma exchange: UNSUPPORTED off the sharded "
+                      "streaming kind (the run refuses before "
+                      "allocating)", 0))
     if fuse and len(local) == 3:
         from ..ops.pallas.fused import (
             _halo_per_micro,
@@ -207,15 +228,28 @@ def estimate_run_bytes(
             # overlap: dummy interior slabs + the shell strips live
             # alongside the exchanged slabs during the split
             slab_b = 2 * base_b if overlap else base_b
-            parts.append(
-                (f"sharded streaming: {what}"
-                 f"{', x2 overlap split' if overlap else ''})"
-                 if ok else
-                 "sharded streaming: UNBUILDABLE for this mesh/shape "
-                 "(the run refuses before allocating)",
-                 slab_b if ok else 0))
-            if pipeline and ok:
-                parts.append(_pipeline_part(base_b))
+            if ok and exchange == "rdma":
+                # the rdma mode's whole point: boundary chunks ride the
+                # in-kernel VMEM rings — the HBM slab-transient term is
+                # deleted, not discounted
+                parts.append(
+                    ("sharded streaming rdma: slabs ride the in-kernel "
+                     "VMEM rings (no HBM slab transient)", 0))
+                if pipeline:
+                    parts.append(
+                        ("pipelined carried slabs: deleted under rdma "
+                         "(the carry feeds the VMEM rings, no HBM slab "
+                         "set persists across passes)", 0))
+            else:
+                parts.append(
+                    (f"sharded streaming: {what}"
+                     f"{', x2 overlap split' if overlap else ''})"
+                     if ok else
+                     "sharded streaming: UNBUILDABLE for this mesh/shape "
+                     "(the run refuses before allocating)",
+                     slab_b if ok else 0))
+                if pipeline and ok:
+                    parts.append(_pipeline_part(base_b))
         elif sharded and fuse_kind == "padfree":
             # forced pad-free under a mesh: no padded fallback exists
             # (make_sharded_fused_step returns None and cli raises), so
@@ -354,6 +388,7 @@ def check_budget(
     hbm_bytes: Optional[int] = None,
     overlap: bool = False,
     pipeline: bool = False,
+    exchange: str = "ppermute",
 ) -> Tuple[int, List[Tuple[str, int]]]:
     """Raise ValueError with the arithmetic when the run cannot fit.
 
@@ -363,7 +398,7 @@ def check_budget(
     total, parts = estimate_run_bytes(
         stencil, grid, mesh=mesh, fuse=fuse, ensemble=ensemble,
         periodic=periodic, compute=compute, fuse_kind=fuse_kind,
-        overlap=overlap, pipeline=pipeline)
+        overlap=overlap, pipeline=pipeline, exchange=exchange)
     if total > hbm:
         raise ValueError(
             f"config needs ~{total / 2**30:.2f} GiB per device but HBM is "

@@ -25,6 +25,18 @@ slabs)``:
    feeding pass i+1 does not consume interior(i)).  Both directions are
    required for XLA to schedule the transfer across the whole interior
    pass.
+
+The ``exchange="rdma"`` steps add a THIRD structural promise — the
+whole point of the in-kernel remote-DMA mode: **zero XLA collective-
+permute equations anywhere in the step** (:func:`count_remote_dma` /
+:func:`assert_rdma_step_structure`).  A compiled rdma step carries its
+exchange as remote ``dma_start`` equations inside the collective
+pallas_calls (and nothing else — no ``all_gather`` either); the
+interpret-mode step carries the documented ``all_gather`` ring-shift
+emulation (``ops/pallas/remote.py``), still with zero ``ppermute``.
+The independence checks generalize: for rdma bodies the "exchange
+equations" are the all_gathers (interpret) / the remote-DMA
+pallas_calls (compiled) instead of the ppermutes.
 """
 
 from __future__ import annotations
@@ -57,6 +69,106 @@ def count_primitive(closed, name: str) -> int:
     )
 
 
+def _is_remote_dma(eqn) -> bool:
+    """Is this ``dma_start`` a REMOTE copy (carries a device-id operand)?
+
+    Local ``make_async_copy`` binds ``device_id=None`` (its
+    ``device_id_type`` param defaults to MESH); the remote ops of
+    ``ops/pallas/remote.py`` bind a real device id under LOGICAL.  The
+    tree-unflatten is the ground truth; the type check is the fallback
+    if the param tree layout ever drifts.
+    """
+    if eqn.primitive.name != "dma_start":
+        return False
+    try:
+        from jax import tree_util
+
+        flat = tree_util.tree_unflatten(eqn.params["tree"], eqn.invars)
+        return flat[-1] is not None  # trailing leaf group = device_id
+    except Exception:  # noqa: BLE001 — fall back to the type marker
+        dtype = eqn.params.get("device_id_type")
+        return dtype is not None and "LOGICAL" in str(dtype).upper()
+
+
+def count_remote_dma(closed) -> int:
+    """Remote ``dma_start`` equations across all nested jaxprs —
+    including the kernel jaxprs inside every ``pallas_call`` (the
+    in-kernel exchange is exactly what lives there)."""
+    return sum(
+        1
+        for jx in iter_jaxprs(closed.jaxpr)
+        for eqn in jx.eqns
+        if _is_remote_dma(eqn)
+    )
+
+
+def _eqn_contains_remote_dma(eqn) -> bool:
+    """Does this eqn (a pallas_call, scan, ...) nest a remote dma_start?"""
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (list, tuple)) else (v,)
+        for u in vals:
+            jx = None
+            if isinstance(u, jax.core.ClosedJaxpr):
+                jx = u.jaxpr
+            elif isinstance(u, jax.core.Jaxpr):
+                jx = u
+            if jx is None:
+                continue
+            for sub in iter_jaxprs(jx):
+                for e in sub.eqns:
+                    if _is_remote_dma(e) or _eqn_contains_remote_dma(e):
+                        return True
+    return False
+
+
+def assert_rdma_step_structure(closed, compiled: bool) -> Dict[str, int]:
+    """The rdma mode's headline gate: ZERO XLA collective-permute eqns.
+
+    ``compiled=True`` (the step was built with ``interpret=False``)
+    additionally pins the strong form: zero ``all_gather`` too (the
+    exchange must live entirely inside the collective kernels) and at
+    least one remote ``dma_start`` (a step with no exchange at all
+    would pass the zero-collective check vacuously).  Interpret-mode
+    steps carry the documented ``all_gather`` ring-shift emulation, so
+    only the ppermute count is pinned there (plus that SOME emulated
+    exchange exists).  Returns the counts for the caller's report.
+    """
+    n_pp = count_primitive(closed, "ppermute")
+    n_ag = count_primitive(closed, "all_gather")
+    n_rdma = count_remote_dma(closed)
+    assert n_pp == 0, (
+        f"rdma step contains {n_pp} XLA ppermute eqn(s) — the in-kernel "
+        "remote-DMA exchange must replace every collective-permute")
+    if compiled:
+        assert n_ag == 0, (
+            f"compiled rdma step contains {n_ag} all_gather eqn(s) — "
+            "the exchange must live inside the collective kernels, not "
+            "in an XLA collective")
+        assert n_rdma > 0, (
+            "compiled rdma step contains no remote dma_start — the step "
+            "did not exchange at all")
+    else:
+        assert n_ag > 0, (
+            "interpret rdma step contains no all_gather ring shift — "
+            "the step did not exchange at all")
+    return {"n_ppermute": n_pp, "n_all_gather": n_ag,
+            "n_remote_dma": n_rdma}
+
+
+def _exchange_eqns(jx, exchange: str):
+    """The equations that ARE the halo exchange in this (sub-)jaxpr:
+    ppermutes (default), or — for rdma — all_gathers (the interpret
+    emulation) plus pallas_calls nesting a remote dma_start (the
+    compiled collective kernels)."""
+    if exchange != "rdma":
+        return [e for e in jx.eqns if e.primitive.name == "ppermute"]
+    out = [e for e in jx.eqns if e.primitive.name == "all_gather"]
+    out += [e for e in jx.eqns
+            if e.primitive.name == "pallas_call"
+            and _eqn_contains_remote_dma(e)]
+    return out
+
+
 def _producer_map(jx):
     producer = {}
     for eqn in jx.eqns:
@@ -87,45 +199,51 @@ def _ancestor_eqns(jx, seeds):
 
 
 def interior_exchange_independence(
-    closed, local_shape: Sequence[int]
+    closed, local_shape: Sequence[int], exchange: str = "ppermute"
 ) -> Dict[str, object]:
     """Two-sided reachability report between the interior ``pallas_call``
-    (the one producing full ``local_shape`` outputs) and every
-    ``ppermute``, inside the (sub-)jaxpr that holds the collectives.
+    (the one producing full ``local_shape`` outputs) and every exchange
+    equation (``ppermute`` by default; the all_gather / remote-DMA
+    collective calls for ``exchange="rdma"``), inside the (sub-)jaxpr
+    that holds the exchange.
 
     Returns ``{"n_ppermute", "interior_depends_on_exchange",
-    "exchange_depends_on_interior"}``; raises ``AssertionError`` when no
-    ppermute or no interior pallas_call exists anywhere (a structural
-    check against the wrong function is meaningless).
+    "exchange_depends_on_interior"}`` (the count key keeps its name for
+    schema stability — for rdma it counts the exchange eqns); raises
+    ``AssertionError`` when no exchange or no interior pallas_call
+    exists anywhere (a structural check against the wrong function is
+    meaningless).
     """
     local_shape = tuple(int(s) for s in local_shape)
     for jx in iter_jaxprs(closed.jaxpr):
-        perms = [e for e in jx.eqns if e.primitive.name == "ppermute"]
+        perms = _exchange_eqns(jx, exchange)
         if not perms:
             continue
+        perm_ids = {id(e) for e in perms}
         interior = [
             e for e in jx.eqns
             if e.primitive.name == "pallas_call"
+            and id(e) not in perm_ids
             and any(tuple(ov.aval.shape) == local_shape
                     for ov in e.outvars)
         ]
         assert interior, (
             "no interior pallas_call (full local-shape outputs "
-            f"{local_shape}) in the jaxpr holding the ppermutes")
+            f"{local_shape}) in the jaxpr holding the exchange")
         perm_anc = _ancestor_eqns(jx, perms)
         int_anc = _ancestor_eqns(jx, interior)
         interior_ids = {id(e) for e in interior}
         return {
             "n_ppermute": len(perms),
-            # any ppermute in the interior's producer chain?
+            # any exchange eqn in the interior's producer chain?
             "interior_depends_on_exchange": any(
-                e.primitive.name == "ppermute" for e in int_anc),
-            # any interior call in a ppermute's producer chain?
+                id(e) in perm_ids for e in int_anc),
+            # any interior call in an exchange eqn's producer chain?
             "exchange_depends_on_interior": any(
                 id(e) in interior_ids for e in perm_anc),
         }
-    raise AssertionError("no ppermute anywhere — the step did not "
-                        "exchange at all")
+    raise AssertionError("no exchange anywhere — the step did not "
+                         "exchange at all")
 
 
 def assert_pipeline_body_structure(
@@ -134,35 +252,52 @@ def assert_pipeline_body_structure(
     fields,
     local_shape: Sequence[int],
     overlap: bool,
+    exchange: str = "ppermute",
 ) -> Dict[str, object]:
     """Assert the pipelined body's structural contract; return the report.
 
     ``pipelined_step`` must carry the ``_pipeline_prologue`` /
     ``_pipeline_body`` hooks; ``plain_step`` is the same configuration
-    with ``pipeline=False`` (its ppermute count defines "one exchange
-    round").  ``overlap`` selects whether the two-sided independence is
-    asserted (without the interior/shell split there is no separate
-    interior kernel to be independent OF).
+    with ``pipeline=False`` (its exchange-eqn count defines "one
+    exchange round" — ppermutes by default, the all_gather / remote-DMA
+    collective calls for ``exchange="rdma"``, where the body and the
+    whole step are additionally pinned ppermute-free).  ``overlap``
+    selects whether the two-sided independence is asserted (without the
+    interior/shell split there is no separate interior kernel to be
+    independent OF).
     """
     prologue = pipelined_step._pipeline_prologue
     body = pipelined_step._pipeline_body
     slabs = jax.eval_shape(prologue, fields)
     closed_body = jax.make_jaxpr(body)(fields, slabs)
+    closed_plain = jax.make_jaxpr(plain_step)(fields)
 
-    n_body = count_primitive(closed_body, "ppermute")
-    n_plain = count_primitive(jax.make_jaxpr(plain_step)(fields),
-                              "ppermute")
+    def _count(closed):
+        if exchange != "rdma":
+            return count_primitive(closed, "ppermute")
+        return sum(len(_exchange_eqns(jx, exchange))
+                   for jx in iter_jaxprs(closed.jaxpr))
+
+    n_body = _count(closed_body)
+    n_plain = _count(closed_plain)
     assert n_body == n_plain > 0, (
-        f"pipelined body issues {n_body} ppermutes per iteration, the "
-        f"non-pipelined step {n_plain} — the slab carry must move the "
-        "exchange, not duplicate or drop transfers")
+        f"pipelined body issues {n_body} exchange round(s) per "
+        f"iteration, the non-pipelined step {n_plain} — the slab carry "
+        "must move the exchange, not duplicate or drop transfers")
+    if exchange == "rdma":
+        for closed in (closed_body, closed_plain):
+            assert count_primitive(closed, "ppermute") == 0, (
+                "rdma pipelined structure check found an XLA ppermute "
+                "— the in-kernel exchange must replace every "
+                "collective-permute")
 
     report: Dict[str, object] = {"n_ppermute": n_body}
     if overlap:
-        rep = interior_exchange_independence(closed_body, local_shape)
+        rep = interior_exchange_independence(closed_body, local_shape,
+                                             exchange=exchange)
         assert not rep["interior_depends_on_exchange"], (
-            "interior(i) consumes a ppermute output — the carried slabs "
-            "must be the only exchanged data a pass reads")
+            "interior(i) consumes an exchange output — the carried "
+            "slabs must be the only exchanged data a pass reads")
         assert not rep["exchange_depends_on_interior"], (
             "the exchange feeding pass i+1 consumes interior(i) — next "
             "slabs must be read from the SHELL outputs, not the spliced "
@@ -178,19 +313,29 @@ def check_pipeline_structure(
     k: int = 4,
     kind=None,
     padfree=True,
+    exchange: str = "ppermute",
 ) -> Dict[str, object]:
     """Build a pipelined+overlapped step on the current devices and run
     the full assertion set — the entry point ``scripts/
     check_pipeline_structure.py`` (and hence ``scripts/tier1.sh``)
-    drives.  Trace-only: nothing executes."""
+    drives.  Trace-only: nothing executes.
+
+    ``exchange="rdma"`` forces the streaming kind (the only rdma host),
+    runs the pipelined assertions against the rdma exchange eqns, and
+    ADDITIONALLY pins the zero-ppermute gate on the whole step in BOTH
+    build modes — interpret (what tier-1 executes) and compiled (what a
+    TPU run traces to, remote dma_start and no XLA collective at all).
+    """
     from .. import init_state, make_mesh, make_stencil, shard_fields
     from ..parallel.stepper import make_sharded_fused_step
 
+    if exchange == "rdma":
+        kind, padfree = "stream", None
     st = make_stencil(stencil_name)
     mesh = make_mesh(mesh_shape)
     mk = lambda pipe: make_sharded_fused_step(  # noqa: E731
         st, mesh, grid, k, interpret=True, kind=kind, padfree=padfree,
-        overlap=True, pipeline=pipe)
+        overlap=True, pipeline=pipe, exchange=exchange)
     pipelined, plain = mk(True), mk(False)
     assert pipelined is not None and plain is not None, (
         stencil_name, grid, mesh_shape)
@@ -201,5 +346,15 @@ def check_pipeline_structure(
                           mesh, 3)
     local = tuple(g // c for g, c in
                   zip(grid, tuple(mesh_shape) + (1,) * 3))
-    return assert_pipeline_body_structure(
-        pipelined, plain, fields, local, overlap=True)
+    report = assert_pipeline_body_structure(
+        pipelined, plain, fields, local, overlap=True, exchange=exchange)
+    if exchange == "rdma":
+        report["interpret"] = assert_rdma_step_structure(
+            jax.make_jaxpr(plain)(fields), compiled=False)
+        compiled = make_sharded_fused_step(
+            st, mesh, grid, k, interpret=False, kind="stream",
+            overlap=True, exchange="rdma")
+        assert compiled is not None
+        report["compiled"] = assert_rdma_step_structure(
+            jax.make_jaxpr(compiled)(fields), compiled=True)
+    return report

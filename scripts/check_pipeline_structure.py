@@ -10,8 +10,16 @@ in ``mpi_cuda_process_tpu/utils/jaxprcheck.py`` (also used by
 tests/test_pipeline_fused.py); this wrapper forces the CPU backend with
 virtual devices (the cpuforce recipe) and runs the check on a z-only and
 a 2-axis mesh.  Trace-only — a few seconds, no kernel executes.
+
+``--exchange rdma`` runs the remote-DMA leg instead: the same pipelined
+assertions against the rdma exchange equations, PLUS the zero-ppermute
+gate on the whole step in both build modes — interpret (what tier-1
+executes) and compiled (zero XLA collective anywhere, the exchange
+carried as remote ``dma_start`` eqns inside the collective kernels).
+tier1.sh runs both legs.
 """
 
+import argparse
 import os
 import sys
 
@@ -22,26 +30,50 @@ from cpuforce import force_cpu  # noqa: E402
 
 force_cpu(8)
 
-
-def main() -> int:
-    from mpi_cuda_process_tpu.utils.jaxprcheck import (
-        check_pipeline_structure,
-    )
-
-    cases = [
+_CASES = {
+    "ppermute": [
         # z-only ring, pad-free z-slab kernel
         dict(stencil_name="heat3d", grid=(32, 16, 128),
              mesh_shape=(2, 1, 1), k=4, padfree=True),
         # 2-axis mesh: y shells + two-hop corner ppermutes too
         dict(stencil_name="heat3d", grid=(32, 32, 128),
              mesh_shape=(2, 2, 1), k=4, padfree=True),
-    ]
-    for case in cases:
+    ],
+    "rdma": [
+        # z-only ring, streaming kernel, in-kernel remote-DMA exchange
+        dict(stencil_name="heat3d", grid=(48, 32, 128),
+             mesh_shape=(2, 1, 1), k=4, exchange="rdma"),
+        # 2-axis mesh: y slabs + two-hop corner rings through the
+        # transport too
+        dict(stencil_name="heat3d", grid=(48, 32, 128),
+             mesh_shape=(2, 2, 1), k=4, exchange="rdma"),
+    ],
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--exchange", default="ppermute",
+                    choices=["ppermute", "rdma"],
+                    help="which exchange transport's structural "
+                         "contract to pin (tier1.sh runs both legs)")
+    a = ap.parse_args(argv)
+
+    from mpi_cuda_process_tpu.utils.jaxprcheck import (
+        check_pipeline_structure,
+    )
+
+    for case in _CASES[a.exchange]:
         rep = check_pipeline_structure(**case)
-        print(f"check_pipeline_structure: ok {case['mesh_shape']} "
-              f"(ppermutes/iter={rep['n_ppermute']}, "
-              f"interior->exchange={rep['interior_depends_on_exchange']}, "
-              f"exchange->interior={rep['exchange_depends_on_interior']})")
+        line = (f"check_pipeline_structure[{a.exchange}]: ok "
+                f"{case['mesh_shape']} "
+                f"(exchange-rounds/iter={rep['n_ppermute']}, "
+                f"interior->exchange={rep['interior_depends_on_exchange']}, "
+                f"exchange->interior={rep['exchange_depends_on_interior']}")
+        if a.exchange == "rdma":
+            line += (f", compiled remote-dma={rep['compiled']['n_remote_dma']}"
+                     f", compiled ppermute={rep['compiled']['n_ppermute']}")
+        print(line + ")")
     return 0
 
 

@@ -97,9 +97,16 @@ def _attribution_block(cost, summary) -> str:
              _fmt_bytes(cost.get("hbm_bytes_per_step_per_device")),
              "(not separable)"]]
     if comm:
+        if comm.get("exchange") == "rdma":
+            # in-kernel remote-DMA exchange: attribute the ICI traffic
+            # by its remote-DMA chunk count (zero ppermute by gate)
+            what = (f"exchange ({comm.get('rdma_dma_per_pass')} "
+                    f"rdma-dma/pass, width {comm.get('width_m')})")
+        else:
+            what = (f"exchange ({comm['ppermute_rounds_per_pass']} "
+                    f"ppermute/pass, width {comm.get('width_m')})")
         rows.append([
-            f"exchange ({comm['ppermute_rounds_per_pass']} ppermute/"
-            f"pass, width {comm.get('width_m')})",
+            what,
             f"{t_ici:.4f}",
             _fmt_bytes(int(comm["ici_bytes_per_step"])) + "/step",
             "(not separable)"])

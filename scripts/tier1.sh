@@ -24,6 +24,25 @@ python scripts/check_tier_counts.py || rc=1
 # (seconds); the perf claims it pins can regress with every value test
 # still green (see scripts/check_pipeline_structure.py).
 python scripts/check_pipeline_structure.py || rc=1
+# The remote-DMA leg of the same gate: zero XLA ppermute in the rdma
+# step (interpret AND compiled traces), exchange rounds preserved by
+# the slab carry, two-sided interior independence.  Trace-only.
+python scripts/check_pipeline_structure.py --exchange rdma || rc=1
+# Interpret-mode rdma smoke: a sharded CLI run with --exchange rdma
+# executes the remote-DMA kernels end-to-end on the CPU backend (the
+# loopback VMEM-ring path, honestly tagged 'interpret-emulated' in the
+# manifest's exchange event) and the manifest must validate.
+rm -f /tmp/_t1_rdma.jsonl
+timeout -k 10 300 python -c "
+from cpuforce import force_cpu; force_cpu(8)
+from mpi_cuda_process_tpu import cli
+cli.run(cli.config_from_args(
+    ['--stencil', 'heat3d', '--grid', '48,32,128', '--iters', '8',
+     '--mesh', '2,1,1', '--fuse', '4', '--fuse-kind', 'stream',
+     '--exchange', 'rdma', '--telemetry', '/tmp/_t1_rdma.jsonl']))
+" || rc=1
+timeout -k 10 120 python scripts/obs_report.py /tmp/_t1_rdma.jsonl --check \
+  > /dev/null || rc=1
 # Telemetry + profile smoke: a CPU CLI run must emit a schema-valid
 # manifest (with a chunk-scoped --profile whose attribution degrades
 # HONESTLY on CPU — 'unavailable', never zeros) and obs_report must
