@@ -140,6 +140,19 @@ def _wedge_context():
             out["telemetry_manifest"] = found[0]
     except Exception:
         pass
+    try:
+        # The resume pointer (round-13 satellite): the newest checkpoint
+        # dir + step known to the telemetry manifests, in the same JSON
+        # that reports the wedge — so a human (or the run supervisor,
+        # resilience/supervisor.py) can resume instead of restarting
+        # from zero.
+        from mpi_cuda_process_tpu.resilience import supervisor as _sup
+
+        ck = _sup.find_latest_checkpoint()
+        if ck is not None:
+            out["latest_checkpoint"] = {"dir": ck[0], "step": ck[1]}
+    except Exception:
+        pass
     return out
 
 

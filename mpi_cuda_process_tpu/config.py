@@ -64,6 +64,14 @@ class RunConfig:
     # stats + static cost counters + heartbeat verdicts; None = no trace
     telemetry: Optional[str] = None
     mem_check: str = "error"  # error | warn | off: per-device HBM budget guard
+    # fault-tolerant supervision (resilience/supervisor.py): run in a
+    # child subprocess with checkpointing+telemetry forced on; kill and
+    # resume-relaunch on WEDGED/STALLED verdicts, child death, or a
+    # wall-clock event stall, with bounded exponential backoff
+    supervise: bool = False
+    max_restarts: int = 2  # relaunches before the supervisor gives up
+    restart_backoff: float = 5.0  # backoff base seconds (doubles per restart)
+    supervise_stall_s: float = 600.0  # no-telemetry-events kill threshold
     params: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:
@@ -77,6 +85,47 @@ class RunConfig:
                 d[k] = tuple(d[k])
         known = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in d.items() if k in known})
+
+
+# Launcher-only fields: the supervisor consumes these in the PARENT and
+# must never hand them to the child (a child that re-supervises forks a
+# supervision tree; the whole point of to_argv is a child that runs the
+# one ordinary CLI path).
+_ARGV_SKIP = frozenset({"supervise", "max_restarts", "restart_backoff",
+                        "supervise_stall_s"})
+
+
+def to_argv(cfg: RunConfig) -> list:
+    """The canonical CLI argv reproducing ``cfg`` (supervisor fields
+    excluded).
+
+    The supervisor's child-launch path: every non-default field becomes
+    its ``--flag`` (field underscores map 1:1 to flag dashes — a
+    property ``tests/test_supervisor.py`` round-trips through the real
+    parser, so a new RunConfig field that forgets its CLI flag fails a
+    test instead of silently vanishing from supervised children).  Known
+    lossiness, inherited from the CLI itself: a *string* param value
+    that parses as a number comes back numeric (``parse_params``).
+    """
+    out: list = []
+    defaults = RunConfig()
+    for f in dataclasses.fields(RunConfig):
+        if f.name in _ARGV_SKIP:
+            continue
+        v = getattr(cfg, f.name)
+        if v == getattr(defaults, f.name):
+            continue
+        flag = "--" + f.name.replace("_", "-")
+        if f.name == "params":
+            for k, pv in v.items():
+                out += ["--param", f"{k}={pv}"]
+        elif isinstance(v, bool):
+            out.append(flag)
+        elif isinstance(v, tuple):
+            out += [flag, ",".join(map(str, v))]
+        else:
+            out += [flag, str(v)]
+    return out
 
 
 def parse_int_tuple(s: str) -> Tuple[int, ...]:

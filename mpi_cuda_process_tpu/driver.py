@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .ops.stencil import Fields, Stencil
+from .resilience import faults
 
 
 def frame_mask(
@@ -171,6 +172,11 @@ def make_runner(step_fn, n_steps: int, jit: bool = True):
     the scan, each body pass consumes them and emits the next pass's,
     and the final pass's in-flight slabs are dropped (the epilogue).
     """
+    # Fault point (resilience/faults.py): the scan is about to be built
+    # and jitted — the host-side stand-in for "the compile hung" (fires
+    # once per process; every runner-building entry point shares it, so
+    # a measurement-campaign label can be wedged here deterministically).
+    faults.maybe_fire("compile")
     seed, advance = pipeline_hooks(step_fn)
 
     def run(fields: Fields) -> Fields:

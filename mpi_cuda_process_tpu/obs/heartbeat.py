@@ -35,6 +35,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Union
 
+from ..resilience import faults
+
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
@@ -197,7 +199,11 @@ class Heartbeat(threading.Thread):
                        f"(threshold {self.stall_after_s:.1f}s); probing "
                        "the backend", idle_s=round(idle, 2))
             try:
-                probe = self.probe()
+                # Fault point (resilience/faults.py): heartbeat:wedge
+                # replaces the subprocess probe with a deterministic
+                # WEDGED verdict — the supervisor's kill-on-verdict path
+                # gets a reproducible CPU trigger.
+                probe = faults.injected_heartbeat_verdict() or self.probe()
             except Exception as e:  # noqa: BLE001
                 probe = {"verdict": "INCONCLUSIVE",
                          "detail": f"probe raised {type(e).__name__}: {e}"}
@@ -213,7 +219,32 @@ class Heartbeat(threading.Thread):
                            f"{probe.get('detail', '')}",
                            probe=probe)
 
-    def stop(self, join_timeout_s: float = 5.0) -> None:
-        self._stop_evt.set()
-        if self.is_alive():
-            self.join(join_timeout_s)
+    def stop(self, join_timeout_s: float = 5.0,
+             final_verdict: str = "SUPERVISOR_KILL") -> None:
+        """Stop the watcher.  NEVER raises — the supervisor kill path
+        runs this while tearing down a wedged run, where a secondary
+        exception would mask the wedge it is reporting.
+
+        An open stall episode is CLOSED with a final ``final_verdict``
+        event (default ``SUPERVISOR_KILL``: the run was stopped from
+        outside while stalled) instead of being left dangling — a trace
+        ending mid-episode is indistinguishable from a writer that died.
+        The thread cannot outlive a closed trace: ``_emit`` swallows
+        writer errors and ``TraceWriter`` drops post-close writes, so
+        even a join timeout (a probe still in flight) leaves nothing
+        that can raise into the closing run.
+        """
+        try:
+            if self._stalled_episode:
+                self._stalled_episode = False
+                self._emit(final_verdict,
+                           "watcher stopped while a stall episode was "
+                           "open (supervisor kill / teardown path)")
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass
+        try:
+            self._stop_evt.set()
+            if self.is_alive():
+                self.join(join_timeout_s)
+        except Exception:  # noqa: BLE001
+            pass

@@ -393,6 +393,16 @@ def rows_from_log(log_path: str) -> List[Dict[str, Any]]:
                 e.get("heartbeat"), dict):
             hb = e["heartbeat"].get("verdict") or hb
     rows: List[Dict[str, Any]] = []
+    # restart trail (resilience/): a resumed run names its resume point
+    # in a 'resume' event; the row detail carries it so downstream
+    # consumers (perf_gate) can flag an after-restart value as honest
+    # but restarted.  Old logs never carried the event, so every
+    # pre-existing row detail stays byte-identical.
+    resumed_from = None
+    for e in events:
+        if e.get("kind") == "resume" and \
+                e.get("resumed_from_step") is not None:
+            resumed_from = e["resumed_from_step"]
     if tool == "cli":
         summaries = [e for e in events if e.get("kind") == "summary"]
         for s in summaries:
@@ -403,7 +413,9 @@ def rows_from_log(log_path: str) -> List[Dict[str, Any]]:
                 provenance=_prov_subset(prov),
                 grid=run.get("grid"), mesh=run.get("mesh"),
                 kind=run.get("fuse_kind"), dtype=run.get("dtype"),
-                flags=_flags(run), builder_rev=prov.get("builder_rev")))
+                flags=_flags(run), builder_rev=prov.get("builder_rev"),
+                detail={"resumed_from_step": resumed_from}
+                if resumed_from is not None else None))
     elif tool == "bench":
         for e in events:
             if e.get("kind") != "result":
@@ -415,6 +427,13 @@ def rows_from_log(log_path: str) -> List[Dict[str, Any]]:
             if e.get("kind") != "label":
                 continue
             status = e.get("status")
+            detail = {}
+            if status:
+                detail["status"] = status
+            if e.get("attempts"):
+                # measured after a supervised retry: attempt count rides
+                # the row so the gate can flag the value
+                detail["attempts"] = e["attempts"]
             rows.append(make_row(
                 str(e.get("label")), e.get("mcells_per_s"), source=source,
                 measured_at=e.get("t"), heartbeat=hb,
@@ -425,7 +444,7 @@ def rows_from_log(log_path: str) -> List[Dict[str, Any]]:
                 kind=e.get("compute"),
                 builder_rev=run.get("builder_rev")
                 or prov.get("builder_rev"),
-                detail={"status": status} if status else None))
+                detail=detail or None))
     elif tool == "scaling":
         for e in events:
             if e.get("kind") != "rung":
@@ -568,7 +587,11 @@ def ingest_results(out_path: str,
             backend=rec.get("backend"), grid=rec.get("grid"),
             dtype=rec.get("dtype"), kind=rec.get("compute"),
             builder_rev=rec.get("builder_rev")
-            if isinstance(rec.get("builder_rev"), int) else None))
+            if isinstance(rec.get("builder_rev"), int) else None,
+            # the supervised-retry trail: a value measured after a
+            # restart carries its attempt count into the ledger row
+            detail={"restart_attempts": rec["restart_attempts"]}
+            if rec.get("restart_attempts") else None))
     return append_rows(rows, ledger_path)
 
 

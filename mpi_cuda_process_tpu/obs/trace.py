@@ -285,6 +285,54 @@ def validate_log(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
     return manifest, events
 
 
+class LogTail:
+    """Incremental JSONL reader: ``poll()`` returns newly appended records.
+
+    The supervisor's view of a live child: it tails the child's
+    telemetry log between polls, consuming only COMPLETE lines (a child
+    SIGKILLed mid-write leaves a partial last line, which must stay
+    unconsumed until — if ever — its terminator lands, never be parsed
+    as garbage).  Reads in binary and tracks a byte offset so a decode
+    boundary can't desync the position.  A missing file (child not yet
+    started, or dead before its first event) yields no records rather
+    than raising; malformed complete lines are counted and skipped —
+    the watcher must survive anything a dying process leaves behind.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.malformed = 0
+        self._pos = 0
+
+    def poll(self) -> List[Dict[str, Any]]:
+        try:
+            fh = open(self.path, "rb")
+        except OSError:
+            return []
+        with fh:
+            fh.seek(self._pos)
+            buf = fh.read()
+        end = buf.rfind(b"\n")
+        if end < 0:
+            return []
+        self._pos += end + 1
+        out: List[Dict[str, Any]] = []
+        for line in buf[:end + 1].splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line.decode("utf-8", errors="replace"))
+            except ValueError:
+                self.malformed += 1
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+            else:
+                self.malformed += 1
+        return out
+
+
 def find_latest_manifest(
     search: Optional[Sequence[str]] = None,
 ) -> Optional[Tuple[str, Dict[str, Any]]]:

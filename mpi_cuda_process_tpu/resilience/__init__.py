@@ -1,0 +1,34 @@
+"""Fault tolerance: deterministic fault injection + the run supervisor.
+
+Three measurement rounds ended as 0.0/stale scoreboards because nothing
+in the stack could do more than *record* a wedge: the heartbeat wrote
+WEDGED verdicts, checkpointing could resume bit-exactly, the ledger
+quarantined the corpses — but no component connected them, so a wedge
+still cost the whole run (ROADMAP open item 5; the reference's failure
+story is a dead rank hanging its peer forever in blocking ``MPI_Recv``,
+kernel.cu:215).  This package is the connection:
+
+* :mod:`.faults` — deterministic, env-var-driven fault points
+  (``FAULT_INJECT=exchange:step=40:sigkill``) threaded into the driver's
+  chunk loop, the checkpoint writer, the runner builder, and the
+  heartbeat probe, so every recovery path has a reproducible CPU trigger
+  instead of a hand-rolled SIGKILL race;
+* :mod:`.supervisor` — runs the simulation in a child subprocess with
+  checkpointing and telemetry forced on, watches the child's
+  heartbeat/manifest events, and on a WEDGED/STALLED verdict (or child
+  death, or a wall-clock stall with no events) kills the child, waits
+  out a bounded exponential backoff, and relaunches with ``--resume``
+  from the latest surviving checkpoint.  The resumed-run-bit-matches-
+  uninterrupted invariant of ``tests/test_fault_injection.py`` is the
+  correctness contract, extended across *automatic* restarts.
+
+Only :mod:`.faults` is imported here: it is pure stdlib and is imported
+from hot-adjacent code (driver, checkpointing, heartbeat), while
+:mod:`.supervisor` pulls in the obs/ layer and is imported explicitly
+(``from mpi_cuda_process_tpu.resilience import supervisor``) by the
+entry points that supervise.
+"""
+
+from . import faults  # noqa: F401  (the cheap, dependency-free half)
+
+__all__ = ["faults"]

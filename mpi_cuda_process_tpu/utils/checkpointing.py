@@ -29,10 +29,13 @@ from typing import Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from ..resilience import faults
+
 _META = "meta.json"
 
 
 def save_checkpoint(path: str, fields, step: int, config: Optional[Dict] = None) -> None:
+    faults.maybe_fire("checkpoint", step=step, phase="before_write")
     fields = [np.asarray(jax.device_get(f)) for f in fields]
     parent = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(parent, exist_ok=True)
@@ -56,6 +59,11 @@ def save_checkpoint(path: str, fields, step: int, config: Optional[Dict] = None)
         }
         with open(os.path.join(tmp, _META), "w") as fh:
             json.dump(meta, fh, indent=2)
+        # Fault point (resilience/faults.py): payload fully written to
+        # the temp dir, atomic rename NOT yet performed — a SIGKILL here
+        # is the exact window the rename guarantee protects, and the
+        # fault suite proves no truncated checkpoint is ever loadable.
+        faults.maybe_fire("checkpoint", step=step, phase="during_write")
         # Never destroy the previous good checkpoint before the new one is in
         # place: move it aside, swap in the new one, then delete the old.
         old = None
@@ -158,6 +166,7 @@ def orbax_save_checkpoint(path: str, fields, step: int,
     is deleted only after the new one has landed, and exactly one step is
     kept (full-state copies at the 4096^3 scale would fill any disk).
     """
+    faults.maybe_fire("checkpoint", step=step, phase="before_write")
     ocp = _orbax()
     path = os.path.abspath(path)
     previous = _orbax_steps(path)

@@ -17,6 +17,13 @@ against).  Verdicts, with a configurable relative noise band
                   suspect, backend mismatch, wedged heartbeat) — it is
                   neither scored nor ever a baseline
 
+A row whose value was measured after a supervised restart/resume
+(``resilience/supervisor.py`` — the detail carries ``attempts`` /
+``restart_attempts`` / ``resumed_from_step``) is judged normally but
+FLAGGED ``[after-restart]`` in the table and counted in the summary:
+the value is honest (resume is bit-exact), the wall-clock path that
+produced it was not uninterrupted.
+
 Exit status: 0 clean, 1 when any row REGRESSED (CI-gate mode), 2 on
 usage/IO errors.  ``--dry`` always exits 0 (the tier-1 smoke mode —
 the table still prints).  ``--update-ledger`` appends the fresh rows
@@ -81,6 +88,15 @@ def gate(manifest_path: str, ledger_path: str, noise: float):
     for row in fresh:
         base = baselines.get(ledger_lib.baseline_key(row))
         verdict, ratio = judge(row, base, noise)
+        det = row.get("detail") or {}
+        # A value measured after a supervised restart/resume is HONEST
+        # (the resumed run bit-matches an uninterrupted one — the
+        # checkpoint contract) but flagged: the wall-clock path that
+        # produced it included a kill+relaunch, so a surprising number
+        # deserves the extra context before anyone chases it.
+        restarted = bool(det.get("attempts", 0) and det["attempts"] > 1) \
+            or bool(det.get("restart_attempts")) \
+            or det.get("resumed_from_step") is not None
         out.append({
             "label": row["label"],
             "backend": row["key"].get("backend"),
@@ -90,6 +106,7 @@ def gate(manifest_path: str, ledger_path: str, noise: float):
             "baseline": base["value"] if base else None,
             "ratio": round(ratio, 4) if ratio is not None else None,
             "quarantine": row.get("quarantine"),
+            "restarted": restarted,
             "baseline_source": base["source"] if base else None,
             "baseline_measured_at": base.get("measured_at")
             if base else None,
@@ -103,6 +120,8 @@ def _table(rows):
     for r in rows:
         why = r["quarantine"] if r["verdict"] == "QUARANTINED" \
             else (r["baseline_source"] or "")
+        if r.get("restarted"):
+            why = ("[after-restart] " + (why or "")).strip()
         body.append([
             r["label"][:58], r["verdict"],
             "-" if r["value"] is None else f"{r['value']:g}",
@@ -162,8 +181,10 @@ def main(argv=None) -> int:
           f"(noise +/-{a.noise:.0%})")
     print(_table(verdicts) if verdicts else "(no measurement rows in "
                                            "this manifest)")
+    restarted = sum(1 for r in verdicts if r.get("restarted"))
     print("summary: " + "  ".join(
-        f"{v}={counts.get(v, 0)}" for v in VERDICT_ORDER))
+        f"{v}={counts.get(v, 0)}" for v in VERDICT_ORDER)
+        + (f"  restarted={restarted}" if restarted else ""))
 
     if a.update_ledger:
         n = ledger_lib.append_rows(fresh, ledger_path)

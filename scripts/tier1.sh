@@ -61,6 +61,38 @@ cli.run(cli.config_from_args(
 " || rc=1
 timeout -k 10 120 python scripts/obs_report.py /tmp/_t1_obs.jsonl --check \
   > /dev/null || rc=1
+# Supervisor smoke (resilience/): a CPU run with an injected mid-run
+# wedge (FAULT_INJECT=exchange:step=40:hang) must be detected by the
+# supervisor's wall-clock watchdog, killed, relaunched with --resume
+# from the surviving step-30 checkpoint, and completed — with the
+# restart and the resumed_from_step landing in the supervisor's own
+# schema-valid obs log.  The bit-exactness of the resumed state is
+# pinned by the default-tier tests; this smoke pins the end-to-end
+# CLI-mode loop every build.
+rm -rf /tmp/_t1_sup
+timeout -k 10 240 env FAULT_INJECT='exchange:step=40:hang' \
+  FAULT_HANG_S=120 python -c "
+import json
+from cpuforce import force_cpu; force_cpu()
+from mpi_cuda_process_tpu.config import RunConfig
+from mpi_cuda_process_tpu.resilience import supervisor as sup
+rc = sup.run_supervised(RunConfig(
+    stencil='life', grid=(64, 64), iters=100, seed=7,
+    checkpoint_every=10, checkpoint_dir='/tmp/_t1_sup/ck',
+    telemetry='/tmp/_t1_sup/run.jsonl', supervise=True,
+    max_restarts=2, restart_backoff=0.3, supervise_stall_s=8.0))
+assert rc == 0, f'supervisor rc={rc}'
+evs = [json.loads(l)
+       for l in open('/tmp/_t1_sup/run.supervisor.jsonl') if l.strip()]
+kinds = [e.get('kind') for e in evs]
+assert 'restart' in kinds and 'give_up' not in kinds, kinds
+resumed = [e.get('resumed_from_step') for e in evs
+           if e.get('kind') == 'launch' and e.get('resume')]
+assert resumed and resumed[0] == 30, evs
+print('supervisor smoke ok: resumed_from_step', resumed[0])
+" || rc=1
+timeout -k 10 120 python scripts/obs_report.py \
+  /tmp/_t1_sup/run.supervisor.jsonl --check > /dev/null || rc=1
 # Ledger + perf-gate smoke against a throwaway ledger: backfill the
 # historical BENCH_r0*/results_r0* files (quarantine rules exercised on
 # the real wedge rounds), ingest the smoke manifest, and run the gate in

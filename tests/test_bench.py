@@ -216,6 +216,32 @@ def test_stale_record_carries_last_real_measurement(bench, tmp_path):
         bench._CACHE = old
 
 
+def test_wedged_record_carries_checkpoint_resume_pointer(
+        bench, tmp_path, monkeypatch):
+    """Round-13 satellite: the wedged-path record names the latest
+    checkpoint dir + step next to ``last_real_measurement``, so the same
+    JSON that reports the wedge also holds the resume pointer a human
+    (or the supervisor) needs."""
+    from mpi_cuda_process_tpu.obs import trace as trace_lib
+    from mpi_cuda_process_tpu.utils import checkpointing
+
+    tel = tmp_path / "telemetry"
+    monkeypatch.setenv("OBS_TELEMETRY_DIR", str(tel))
+    ck = str(tmp_path / "ck")
+    checkpointing.save_checkpoint(ck, (), 40, {})
+    with trace_lib.TraceWriter(str(tel / "run.jsonl")) as w:
+        w.write_manifest(trace_lib.build_manifest(
+            "cli", {"stencil": "life", "checkpoint_dir": ck}))
+    old = bench._CACHE
+    try:
+        bench._CACHE = str(tmp_path / "absent.json")
+        rec = bench._stale_fallback_record()
+    finally:
+        bench._CACHE = old
+    assert rec["latest_checkpoint"] == {"dir": ck, "step": 40}
+    json.dumps(rec)  # the record must stay one serializable JSON line
+
+
 def test_mktable_regenerates_from_campaign(capsys):
     """benchmarks/mktable.py renders the measured table from a results
     file with the LIVE auto-policy picks bolded — the mechanism that

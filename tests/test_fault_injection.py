@@ -1,10 +1,19 @@
-"""Fault injection: kill a running simulation process, resume, bit-match.
+"""Fault injection: kill/hang a live run deterministically, resume, bit-match.
 
 SURVEY.md §5.3: the reference has no failure story at all — a dead rank hangs
 its peer forever in blocking MPI_Recv (kernel.cu:215).  This framework's
-recovery path is checkpoint/restart; this test proves it end-to-end by
-SIGKILLing a live run mid-flight (no atexit, no flush — a real crash) and
-resuming from whatever checkpoint survived.
+recovery path is checkpoint/restart; this suite proves it end-to-end with
+the deterministic fault harness (``resilience/faults.py``): a child process
+inherits ``FAULT_INJECT`` and dies/hangs at an exact declared point (no
+sleep-and-hope races), then the parent resumes from whatever checkpoint
+survived and the result must bit-match an uninterrupted run.
+
+Covered here: SIGKILL at an exact step boundary (npy AND orbax backends),
+SIGKILL *during* a checkpoint write (the atomic-rename window — no
+truncated checkpoint is ever loadable), plus the original race-based kill
+(kept: it is the only test that kills at a point NOT declared in advance).
+The supervisor built on these primitives is proven in
+``tests/test_supervisor.py``.
 """
 
 import os
@@ -14,6 +23,7 @@ import sys
 import time
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -23,22 +33,43 @@ import os
 os.environ.pop("XLA_FLAGS", None)
 import jax; jax.config.update("jax_platforms", "cpu")
 from mpi_cuda_process_tpu.cli import main
-main([
-    "--stencil", "life", "--grid", "64,64", "--iters", "2000", "--seed", "11",
-    "--checkpoint-every", "10", "--checkpoint-dir", {ck!r},
-    "--log-every", "10",
-])
+main({argv!r})
 """
+
+_SIGKILL = -signal.SIGKILL
+
+
+def _run_child(argv, fault, extra_env=None, timeout=240):
+    """Run a CPU CLI child with ``FAULT_INJECT=fault``; return its rc."""
+    env = dict(os.environ, FAULT_INJECT=fault, FAULT_ATTEMPT="0")
+    env.update(extra_env or {})
+    p = subprocess.run(
+        [sys.executable, "-c", _CHILD.format(repo=REPO, argv=list(argv))],
+        env=env, timeout=timeout,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    return p.returncode
+
+
+def _bitmatch(resumed_fields, reference_fields):
+    for a, b in zip(resumed_fields, reference_fields):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_sigkill_then_resume_bitmatch(tmp_path):
+    """The original RACE-based kill: no declared fault point, a live run
+    SIGKILLed at whatever step it happens to be on.  Kept alongside the
+    deterministic suite — it is the only test whose kill point the code
+    under test cannot anticipate."""
     from mpi_cuda_process_tpu.cli import run
     from mpi_cuda_process_tpu.config import RunConfig
     from mpi_cuda_process_tpu.utils import checkpointing
 
     ck = str(tmp_path / "ck")
+    argv = ["--stencil", "life", "--grid", "64,64", "--iters", "2000",
+            "--seed", "11", "--checkpoint-every", "10",
+            "--checkpoint-dir", ck, "--log-every", "10"]
     proc = subprocess.Popen(
-        [sys.executable, "-c", _CHILD.format(repo=REPO, ck=ck)],
+        [sys.executable, "-c", _CHILD.format(repo=REPO, argv=argv)],
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
     )
     # wait for a mid-run checkpoint, then crash the process hard
@@ -62,5 +93,103 @@ def test_sigkill_then_resume_bitmatch(tmp_path):
     resumed, _ = run(RunConfig(**base, iters=horizon, resume=True,
                                checkpoint_dir=ck, checkpoint_every=10))
     full, _ = run(RunConfig(**base, iters=horizon))
-    np.testing.assert_array_equal(
-        np.asarray(resumed[0]), np.asarray(full[0]))
+    _bitmatch(resumed, full)
+
+
+def test_fault_sigkill_at_step_resume_bitmatch(tmp_path):
+    """Deterministic mid-run death: FAULT_INJECT=exchange:step=40:sigkill
+    fires at the step-40 chunk boundary BEFORE that boundary's save, so
+    the newest survivor is exactly step 30 — no polling, no race."""
+    from mpi_cuda_process_tpu.cli import run
+    from mpi_cuda_process_tpu.config import RunConfig
+    from mpi_cuda_process_tpu.utils import checkpointing
+
+    ck = str(tmp_path / "ck")
+    rc = _run_child(
+        ["--stencil", "life", "--grid", "64,64", "--iters", "2000",
+         "--seed", "11", "--checkpoint-every", "10",
+         "--checkpoint-dir", ck],
+        fault="exchange:step=40:sigkill")
+    assert rc == _SIGKILL, f"child should die by SIGKILL, rc={rc}"
+    assert checkpointing.latest_step(ck) == 30
+
+    base = dict(stencil="life", grid=(64, 64), seed=11)
+    resumed, _ = run(RunConfig(**base, iters=60, resume=True,
+                               checkpoint_dir=ck, checkpoint_every=10))
+    full, _ = run(RunConfig(**base, iters=60))
+    _bitmatch(resumed, full)
+
+
+def test_fault_sigkill_during_checkpoint_write_atomic(tmp_path):
+    """SIGKILL in the atomic-rename window: the step-20 payload is fully
+    written to the temp dir but never renamed into place.  The rename
+    guarantee means the step-10 checkpoint stays the newest LOADABLE
+    state — a truncated/unrenamed checkpoint must never be loadable."""
+    from mpi_cuda_process_tpu.cli import run
+    from mpi_cuda_process_tpu.config import RunConfig
+    from mpi_cuda_process_tpu.utils import checkpointing
+
+    ck = str(tmp_path / "ck")
+    rc = _run_child(
+        ["--stencil", "life", "--grid", "64,64", "--iters", "2000",
+         "--seed", "11", "--checkpoint-every", "10",
+         "--checkpoint-dir", ck],
+        fault="checkpoint:during_write:step=20:sigkill")
+    assert rc == _SIGKILL
+    # the interrupted write left its temp dir behind (the kill preempted
+    # cleanup) but the checkpoint the loader sees is the intact step 10
+    assert checkpointing.checkpoint_format(ck) == "npy"
+    assert checkpointing.latest_step(ck) == 10
+    fields, step, _ = checkpointing.load_any(ck)
+    assert step == 10 and all(np.isfinite(f).all() if np.issubdtype(
+        f.dtype, np.inexact) else True for f in fields)
+
+    base = dict(stencil="life", grid=(64, 64), seed=11)
+    resumed, _ = run(RunConfig(**base, iters=40, resume=True,
+                               checkpoint_dir=ck, checkpoint_every=10))
+    full, _ = run(RunConfig(**base, iters=40))
+    _bitmatch(resumed, full)
+
+
+def test_fault_sigkill_before_first_checkpoint_write(tmp_path):
+    """Death before ANY completed save: nothing loadable may exist (a
+    partially-materialized first checkpoint would resume garbage)."""
+    from mpi_cuda_process_tpu.utils import checkpointing
+
+    ck = str(tmp_path / "ck")
+    rc = _run_child(
+        ["--stencil", "life", "--grid", "64,64", "--iters", "2000",
+         "--seed", "11", "--checkpoint-every", "10",
+         "--checkpoint-dir", ck],
+        fault="checkpoint:during_write:sigkill")  # first save, step 10
+    assert rc == _SIGKILL
+    assert checkpointing.checkpoint_format(ck) is None
+    assert checkpointing.latest_step(ck) is None
+    with pytest.raises(FileNotFoundError):
+        checkpointing.load_any(ck)
+
+
+def test_fault_sigkill_orbax_resume_bitmatch(tmp_path):
+    """The orbax backend gets the same deterministic sigkill-resume-
+    bitmatch contract the npy backend has: per-shard checkpoints written
+    before the kill restore bit-exactly onto the resumed run."""
+    from mpi_cuda_process_tpu.cli import run
+    from mpi_cuda_process_tpu.config import RunConfig
+    from mpi_cuda_process_tpu.utils import checkpointing
+
+    ck = str(tmp_path / "ck")
+    rc = _run_child(
+        ["--stencil", "life", "--grid", "64,64", "--iters", "2000",
+         "--seed", "11", "--checkpoint-every", "10",
+         "--checkpoint-dir", ck, "--checkpoint-backend", "orbax"],
+        fault="exchange:step=40:sigkill")
+    assert rc == _SIGKILL
+    assert checkpointing.checkpoint_format(ck) == "orbax"
+    assert checkpointing.latest_step(ck) == 30
+
+    base = dict(stencil="life", grid=(64, 64), seed=11)
+    resumed, _ = run(RunConfig(**base, iters=60, resume=True,
+                               checkpoint_dir=ck, checkpoint_every=10,
+                               checkpoint_backend="orbax"))
+    full, _ = run(RunConfig(**base, iters=60))
+    _bitmatch(resumed, full)

@@ -168,6 +168,63 @@ def test_merge_record_preserves_other_labels(M, tmp_path):
     assert got == {"other": {"x": 1}, "new": {"y": 2}}
 
 
+def test_campaign_survives_one_wedged_label(M, tmp_path, monkeypatch):
+    """The round-13 acceptance pin: a campaign with ONE injected wedged
+    label (FAULT_INJECT=label:name=...:hang) completes every other
+    label, retries the wedged one (the wedge costs the in-flight
+    ATTEMPT, not the label), records the restart in the results record
+    AND the ledger row, and a re-run re-executes nothing."""
+    import time as _time
+
+    from mpi_cuda_process_tpu.obs import ledger as ledger_lib
+
+    wedged = "heat2d_512_f32"
+    other = "sor2d_1024_f32_jnp"
+    M.CONFIGS = [c for c in M.CONFIGS if c[0] in (wedged, other)]
+    assert len(M.CONFIGS) == 2
+    # attempt 0 of the wedged label hangs (killed at the budget);
+    # attempt 1 — FAULT_ATTEMPT=1 in the retried child — runs clean
+    monkeypatch.setenv("FAULT_INJECT", f"label:name={wedged}:hang")
+    monkeypatch.setenv("FAULT_HANG_S", "120")
+    ledger = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("OBS_LEDGER_PATH", ledger)
+    # the campaign-start probe spawns a subprocess; irrelevant here
+    M._tunnel_probe_ok = lambda *a, **kw: True
+
+    out = str(tmp_path / "r.json")
+    argv = sys.argv
+    sys.argv = ["measure.py", "--out", out, "--label-budget", "12",
+                "--restart-backoff", "0.1"]
+    try:
+        M.main()
+    finally:
+        sys.argv = argv
+
+    results = json.loads((tmp_path / "r.json").read_text())
+    assert "mcells_per_s" in results[other], results[other]
+    assert "mcells_per_s" in results[wedged], results[wedged]
+    assert results[wedged]["restart_attempts"] == 1
+    assert "restart_attempts" not in results[other]
+
+    # the ledger row carries the restart trail (attempt count)
+    rows = [r for r in ledger_lib.read_rows(ledger)
+            if r["label"] == wedged and r["status"] == "ok"]
+    assert rows and rows[-1]["detail"]["restart_attempts"] == 1
+
+    # campaign-level resume: a re-run skips every completed label
+    # (identical records — nothing was re-measured)
+    before = json.loads((tmp_path / "r.json").read_text())
+    assert M.count_runnable(out) == 0
+    t0 = _time.time()
+    sys.argv = ["measure.py", "--out", out, "--label-budget", "12"]
+    try:
+        M.main()
+    finally:
+        sys.argv = argv
+    assert json.loads((tmp_path / "r.json").read_text()) == before
+    assert _time.time() - t0 < 10, "cached re-run must spawn no children"
+
+
 def test_explicit_tile_labels_construct(M):
     """The @BZxBY hedge labels must build a real kernel (interpret mode):
     a typo'd tile pair would otherwise surface only on the real chip."""

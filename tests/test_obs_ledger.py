@@ -256,6 +256,40 @@ def test_gate_update_ledger_and_self_baseline_exclusion(tmp_path,
     assert "NO_BASELINE" in capsys.readouterr().out
 
 
+def test_restart_trail_rides_rows_and_flags_the_gate(tmp_path, gate_mod,
+                                                     capsys):
+    """Round-13 satellite: a value measured after a supervised restart
+    (measure 'label' events with attempts > 1, cli 'resume' events) is
+    judged normally — honest — but carries the trail in the row detail
+    and is flagged [after-restart] by the gate, never quarantined."""
+    # measure log: one label measured on its second attempt
+    mlog = str(tmp_path / "measure.jsonl")
+    with trace.TraceWriter(mlog) as w:
+        w.write_manifest(trace.build_manifest(
+            "measure", {"out": "r.json", "builder_rev": 9}))
+        w.event("label", label="lab_retry", status="ok",
+                mcells_per_s=50.0, compute="jnp", attempts=2)
+    rows = ledger.rows_from_log(mlog)
+    assert rows[0]["status"] == "ok"
+    assert rows[0]["detail"]["attempts"] == 2
+    # cli log: a resumed run names its resume point
+    clog = str(tmp_path / "cli.jsonl")
+    with trace.TraceWriter(clog) as w:
+        w.write_manifest(trace.build_manifest(
+            "cli", {"stencil": "life", "grid": [64, 64], "resume": True}))
+        w.event("resume", resumed_from_step=30)
+        w.event("summary", mcells_per_s=12.0)
+    crows = ledger.rows_from_log(clog)
+    assert crows[0]["detail"]["resumed_from_step"] == 30
+
+    lpath = _seed_baseline(tmp_path, "lab_retry", 50.0)
+    assert gate_mod.main([mlog, "--ledger", lpath]) == 0
+    out = capsys.readouterr().out
+    assert "[after-restart]" in out and "restarted=1" in out
+    assert "QUARANTINED" not in out.split("summary:")[0].split(
+        "lab_retry")[1].split("\n")[0]
+
+
 def test_gate_backfill_mode(tmp_path, gate_mod, capsys, monkeypatch):
     monkeypatch.setenv("OBS_LEDGER_PATH", str(tmp_path / "l.jsonl"))
     assert gate_mod.main(["--backfill"]) == 0
