@@ -401,7 +401,36 @@ def _write_bench_telemetry(rec, grid, steps, fuse, backend):
         return None
 
 
+def _maybe_serve():
+    """``BENCH_SERVE_PORT``: live console over the telemetry dir.
+
+    bench.py has no CLI (the driver runs it bare), so the live-console
+    opt-in is an env var: when set, a campaign aggregator
+    (obs/serve.py) serves the shared telemetry directory for the
+    duration of the bench — the same /metrics + /status.json +
+    /events surface as ``cli --serve``, picking up the manifest this
+    run writes at the end (and any concurrent run's).  Never
+    load-bearing; returns the server or None.
+    """
+    port = os.environ.get("BENCH_SERVE_PORT")
+    if not port:
+        return None
+    try:
+        from mpi_cuda_process_tpu.obs import serve as serve_lib
+        from mpi_cuda_process_tpu.obs import trace as obs_trace
+
+        server = serve_lib.serve_campaign(
+            obs_trace.default_telemetry_dir(), port=int(port))
+        print(f"[bench] obs console at {server.url}", file=sys.stderr)
+        return server
+    except Exception as e:
+        print(f"[bench] BENCH_SERVE_PORT disabled "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
+        return None
+
+
 def main():
+    server = _maybe_serve()
     backend = jax.default_backend()
     if backend == "cpu":
         grid, steps, fuse = (128, 128, 128), 10, 0
@@ -479,6 +508,8 @@ def main():
             pass
     _done.set()
     _emit(rec)
+    if server is not None:
+        server.close()  # final drain picks up the manifest written above
 
 
 if __name__ == "__main__":

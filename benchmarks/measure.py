@@ -888,6 +888,18 @@ def main():
                          "log while a label is still hanging — the "
                          "live view the wedge rounds never had.  "
                          "Render with scripts/obs_report.py")
+    ap.add_argument("--serve", type=int, default=None, metavar="PORT",
+                    help="campaign live console (obs/serve.py "
+                         "serve_campaign): an HTTP aggregator over the "
+                         "telemetry directory — /status.json exposes "
+                         "per-label progress (status, Mcells/s, "
+                         "attempts) while the campaign runs, /metrics "
+                         "the Prometheus counters, /events the "
+                         "incremental NDJSON tail.  PORT 0 = ephemeral "
+                         "(bound address printed + recorded as a "
+                         "'serve' event).  Implies --telemetry (a "
+                         "default path is derived when unset); watch "
+                         "with scripts/obs_top.py URL")
     args = ap.parse_args()
 
     if args.count_runnable:
@@ -915,6 +927,15 @@ def main():
               file=sys.stderr)
         return
 
+    if args.serve is not None and not args.telemetry:
+        # the console aggregates label events from the telemetry log;
+        # --serve without one would be a blind server
+        from mpi_cuda_process_tpu.obs import trace as _trace
+
+        args.telemetry = os.path.join(
+            _trace.default_telemetry_dir(),
+            f"measure-{os.getpid()}-{int(time.time())}.jsonl")
+
     session = None
     if args.telemetry:
         try:
@@ -941,6 +962,31 @@ def main():
             print(f"[measure] telemetry disabled ({type(e).__name__}: {e})",
                   file=sys.stderr)
             session = None
+
+    server = None
+    if args.serve is not None:
+        # Campaign aggregator (obs/serve.py): watches the telemetry
+        # DIRECTORY (new manifests picked up between polls — child runs
+        # that drop logs there appear live) plus this harness's own log
+        # for the per-label progress table in /status.json.
+        try:
+            from mpi_cuda_process_tpu.obs import serve as serve_lib
+
+            server = serve_lib.serve_campaign(
+                os.path.dirname(os.path.abspath(args.telemetry)),
+                port=args.serve)
+            server.console.watch(os.path.abspath(args.telemetry))
+            print(f"[measure] campaign console at {server.url} "
+                  "(/status.json has the per-label table)",
+                  file=sys.stderr)
+            if session is not None:
+                session.event("serve", url=server.url, port=server.port,
+                              endpoints=["/metrics", "/status.json",
+                                         "/events"])
+        except Exception as e:  # noqa: BLE001 — never block the campaign
+            print(f"[measure] --serve disabled ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            server = None
 
     def _tel_label(label, status=None, wall_s=None, attempts=None):
         if session is None:
@@ -1100,6 +1146,8 @@ def main():
         session.finish(labels_run=n_run,
                        runnable_after=count_runnable(args.out))
         session.close()
+    if server is not None:
+        server.close()  # final drain happens inside close()
 
     # Every FULL campaign run updates the durable cross-round ledger from
     # its results table (idempotent append; errored/suspect labels land

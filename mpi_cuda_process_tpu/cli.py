@@ -228,6 +228,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "compile-hang case where the in-process "
                         "heartbeat may be hung too; default 600 — set "
                         "above your longest silent phase)")
+    p.add_argument("--serve", type=int, default=None, metavar="PORT",
+                   dest="serve_port",
+                   help="live run console (obs/serve.py): start an HTTP "
+                        "service over this run's telemetry log exposing "
+                        "/metrics (Prometheus text: steps/s, Gcells/s, "
+                        "compile vs steady split, recompiles, memory "
+                        "peak, heartbeat verdict, roofline gap), "
+                        "/status.json (manifest provenance + latest "
+                        "chunk + heartbeat verdict + restart trail — "
+                        "the remote answer to 'is it wedged?'), and "
+                        "/events?after=SEQ (incremental NDJSON tail, "
+                        "bounded long-poll).  PORT 0 binds an ephemeral "
+                        "port; the bound address is printed and written "
+                        "into the manifest as a 'serve' event.  Implies "
+                        "--telemetry (a default path is derived when "
+                        "unset).  The server only tails the log the run "
+                        "was writing anyway: zero ops in the jitted "
+                        "step, and endpoint handlers never touch the "
+                        "run loop.  Shuts down with the run")
     p.add_argument("--mem-check", default="error",
                    choices=["error", "warn", "off"],
                    help="per-device HBM budget guard (TPU runs): estimate "
@@ -258,6 +277,7 @@ def config_from_args(argv=None) -> RunConfig:
         supervise=a.supervise, max_restarts=a.max_restarts,
         restart_backoff=a.restart_backoff,
         supervise_stall_s=a.supervise_stall_s,
+        serve_port=a.serve_port,
         params=parse_params(a.param),
     )
 
@@ -716,6 +736,15 @@ def run(cfg: RunConfig) -> Tuple:
     a JaxRuntimeError (round-2 verdict: ``_PALLAS_WINS`` used to route
     heat3d27 straight into a compile failure).
     """
+    if cfg.serve_port is not None and not cfg.telemetry:
+        # --serve tails the telemetry log; without one there is nothing
+        # to serve, so derive a default path (same discipline as the
+        # supervisor's forced telemetry)
+        from .obs import trace as trace_lib
+
+        cfg = dataclasses.replace(cfg, telemetry=os.path.join(
+            trace_lib.default_telemetry_dir(),
+            f"serve-{os.getpid()}-{int(time.time())}.jsonl"))
     fused_cfg = maybe_auto_fuse(cfg)
     # "Did auto actually pick a Pallas path?" — not just eligibility: the
     # raw-step builder can decline (untileable shape), in which case the run
@@ -808,9 +837,17 @@ def _open_telemetry(cfg: RunConfig):
     """
     from . import obs
 
+    try:
+        # the heartbeat stall threshold is env-tunable (OBS_STALL_AFTER_S)
+        # so a supervisor/test can make the in-process verdict land
+        # before its own wall-clock kill; default unchanged (600 s)
+        stall_after_s = float(os.environ.get("OBS_STALL_AFTER_S", "600")
+                              or 600)
+    except ValueError:
+        stall_after_s = 600.0
     return obs.open_session(
         cfg.telemetry, tool="cli", run=dataclasses.asdict(cfg),
-        step_unit=max(1, cfg.fuse))
+        step_unit=max(1, cfg.fuse), stall_after_s=stall_after_s)
 
 
 def _emit_static_cost(cfg: RunConfig, st, session) -> None:
@@ -827,10 +864,36 @@ def _emit_static_cost(cfg: RunConfig, st, session) -> None:
                   exc_info=True)
 
 
+def _open_serve(cfg: RunConfig, session):
+    """Live console for ``--serve PORT`` (obs/serve.py), or None.
+
+    The server tails the session's log — the run loop never sees it.
+    The bound address is printed AND recorded as a ``serve`` event so a
+    remote monitor (scripts/obs_top.py) can discover the URL from the
+    manifest log alone.  Never load-bearing: a bind failure logs and
+    the run proceeds unserved.
+    """
+    if cfg.serve_port is None:
+        return None
+    try:
+        from .obs import serve as serve_lib
+
+        server = serve_lib.serve_run(session.path, port=cfg.serve_port)
+        log.info("obs live console serving at %s "
+                 "(/metrics /status.json /events)", server.url)
+        session.event("serve", url=server.url, port=server.port,
+                      endpoints=["/metrics", "/status.json", "/events"])
+        return server
+    except Exception as e:  # noqa: BLE001 — telemetry never load-bearing
+        log.warning("--serve disabled (%s: %s)", type(e).__name__, e)
+        return None
+
+
 def _run_once(cfg: RunConfig) -> Tuple:
     if not cfg.telemetry:
         return _run_measured(cfg, None)
     session = _open_telemetry(cfg)
+    server = _open_serve(cfg, session)
     try:
         return _run_measured(cfg, session)
     except BaseException as e:
@@ -838,6 +901,11 @@ def _run_once(cfg: RunConfig) -> Tuple:
         raise
     finally:
         session.close()
+        if server is not None:
+            # after session.close() so the final summary event is on
+            # disk for the server's last drain; then the console goes
+            # away with the run (no leaked thread — tier-1 pins it)
+            server.close()
 
 
 def _run_measured(cfg: RunConfig, session) -> Tuple:

@@ -72,6 +72,12 @@ class RunConfig:
     max_restarts: int = 2  # relaunches before the supervisor gives up
     restart_backoff: float = 5.0  # backoff base seconds (doubles per restart)
     supervise_stall_s: float = 600.0  # no-telemetry-events kill threshold
+    # live run console (obs/serve.py): --serve PORT starts an HTTP
+    # service over the telemetry log (/metrics, /status.json,
+    # /events?after=SEQ); 0 = ephemeral port (bound address printed and
+    # recorded as a 'serve' event).  Launcher-only: a supervised child
+    # must never try to bind the parent's port, so to_argv drops it.
+    serve_port: Optional[int] = None
     params: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:
@@ -90,9 +96,11 @@ class RunConfig:
 # Launcher-only fields: the supervisor consumes these in the PARENT and
 # must never hand them to the child (a child that re-supervises forks a
 # supervision tree; the whole point of to_argv is a child that runs the
-# one ordinary CLI path).
+# one ordinary CLI path).  serve_port is launcher-only for the same
+# reason: the parent's console serves the child's log, and a child that
+# re-served would race the parent for the port.
 _ARGV_SKIP = frozenset({"supervise", "max_restarts", "restart_backoff",
-                        "supervise_stall_s"})
+                        "supervise_stall_s", "serve_port"})
 
 
 def to_argv(cfg: RunConfig) -> list:

@@ -15,13 +15,17 @@ validator is the single definition) and the same event vocabulary:
 * ``label`` / ``rung`` — benchmark-harness progress records
 * ``error`` / ``summary`` — how the run ended
 
-Two sibling stores complete the layer: ``profile.py`` wraps a
+Sibling stores complete the layer: ``profile.py`` wraps a
 ``jax.profiler`` session scoped to one steady-state chunk and parses
 the emitted trace into interior-compute / exchange / exposed-ICI
 buckets; ``ledger.py`` is the append-only cross-round campaign ledger
 (every manifest ingested, 0.0/stale/suspect values quarantined with
 their heartbeat verdict, best-known-value-with-provenance per label —
-what ``scripts/perf_gate.py`` gates against).
+what ``scripts/perf_gate.py`` gates against); ``metrics.py`` folds the
+event stream into an in-process registry (counters, gauges,
+bounded-reservoir histograms) and ``serve.py`` puts the live HTTP face
+on it (``--serve``: /metrics, /status.json, /events — rendered by
+``scripts/obs_top.py``).
 
 :func:`open_session` is the one-call wiring: trace writer + manifest +
 runtime recorder + heartbeat, bundled in a :class:`Session`.  Telemetry

@@ -1,0 +1,605 @@
+"""In-process metrics registry: the live face of the telemetry stream.
+
+The obs/ layer built in rounds 10-13 is strictly post-hoc — manifests,
+chunk stats, heartbeat verdicts, and supervisor restart trails are JSONL
+files you read *after* (or tail by hand during) a run.  This module is
+the in-memory aggregate those files already imply: counters, gauges and
+bounded-reservoir histograms populated **purely from the events the
+recorder already emits at chunk boundaries** — nothing here touches jax
+tracing, the jitted step, or the run loop (the zero-ops invariant of
+``tests/test_obs.py`` extends to a served run by construction: the
+registry only ever sees records that were going to be written anyway).
+
+Two layers:
+
+* :class:`MetricsRegistry` — a generic, pure-stdlib metric store.
+  Every mutation and every read happens under ONE registry lock, so a
+  :meth:`~MetricsRegistry.snapshot` (and the ``/metrics`` scrape built
+  on it) is **snapshot-consistent**: a reader can never observe half of
+  a multi-metric update (pinned by a concurrent-ingest test).
+  Histograms keep a bounded reservoir of the newest observations (count
+  / sum / min / max remain exact over the full stream) and report
+  nearest-rank p50/p90/p99.
+
+* :class:`RunMetrics` — the obs-vocabulary ingester: feed it manifest /
+  chunk / costmodel / heartbeat / launch / restart / label / summary
+  records (:meth:`RunMetrics.ingest`) and it maintains both the
+  Prometheus-facing registry (steps/s, Gcells/s, compile vs steady
+  split, recompile count, device-memory peak, exchange mode, heartbeat
+  verdict, supervisor restart count, roofline predicted-vs-measured
+  gap) and the structured :meth:`RunMetrics.status` payload — the
+  remote answer to "is it wedged?" that ``obs/serve.py`` exposes as
+  ``/status.json``.
+
+Pure stdlib: importable from anywhere (including the supervisor parent
+watching a wedged child) without dragging a jax backend in.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+_QUANTILES = (0.5, 0.9, 0.99)
+
+
+def quantile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank quantile of an already-sorted list (NaN when empty)."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _prom_name(name: str) -> str:
+    return "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
+
+
+def _prom_label_value(v: Any) -> str:
+    s = str(v)
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_labels(labels: Dict[str, Any]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{_prom_name(str(k))}="{_prom_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _prom_value(v: Any) -> str:
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class Counter:
+    """Monotone counter.  Mutate only through the owning registry's lock
+    (the registry's ``inc`` helper, or inside ``with registry.lock``)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+    def render(self) -> List[str]:
+        return [f"{_prom_name(self.name)} {_prom_value(self.value)}"]
+
+    def snap(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "value": self.value}
+
+
+class Gauge:
+    """Last-value (or peak, via :meth:`set_max`) gauge."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value: Optional[float] = None
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def set_max(self, v: float) -> None:
+        v = float(v)
+        if self.value is None or v > self.value:
+            self.value = v
+
+    def render(self) -> List[str]:
+        if self.value is None:
+            return []
+        return [f"{_prom_name(self.name)} {_prom_value(self.value)}"]
+
+    def snap(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "value": self.value}
+
+
+class Info:
+    """Constant-1 gauge whose payload is its labels (the Prometheus
+    ``_info`` idiom): run identity, exchange mode, heartbeat verdict."""
+
+    kind = "info"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.labels: Dict[str, Any] = {}
+
+    def set(self, **labels: Any) -> None:
+        self.labels = {k: v for k, v in labels.items() if v is not None}
+
+    def render(self) -> List[str]:
+        if not self.labels:
+            return []
+        return [f"{_prom_name(self.name)}{_prom_labels(self.labels)} 1"]
+
+    def snap(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "labels": dict(self.labels)}
+
+
+class Histogram:
+    """Bounded-reservoir histogram: newest ``bound`` observations.
+
+    ``count``/``sum``/``min``/``max`` stay exact over the whole stream;
+    the quantiles (nearest-rank p50/p90/p99) are computed over the
+    reservoir — for the chunk-cadence streams this serves (hundreds of
+    observations per run) the reservoir usually IS the stream, and for
+    multi-day runs the sliding window is the more useful statistic
+    anyway (a throughput regression three hours ago should not hide in
+    a lifetime median).
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "", bound: int = 512):
+        self.name = name
+        self.help = help
+        self.bound = max(1, int(bound))
+        self.reservoir: Deque[float] = collections.deque(maxlen=self.bound)
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.reservoir.append(v)
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    def quantiles(self) -> Dict[float, float]:
+        vals = sorted(self.reservoir)
+        return {q: quantile(vals, q) for q in _QUANTILES}
+
+    def render(self) -> List[str]:
+        name = _prom_name(self.name)
+        out = []
+        for q, v in self.quantiles().items():
+            out.append(f'{name}{{quantile="{q}"}} {_prom_value(v)}')
+        out.append(f"{name}_count {_prom_value(self.count)}")
+        out.append(f"{name}_sum {_prom_value(self.sum)}")
+        return out
+
+    def snap(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max,
+                "quantiles": {str(q): v
+                              for q, v in self.quantiles().items()}}
+
+
+# Prometheus TYPE vocabulary for each metric class (Info renders as a
+# gauge; the bounded-reservoir histogram renders as a summary — it
+# exposes quantiles, not cumulative buckets).
+_PROM_TYPE = {"counter": "counter", "gauge": "gauge", "info": "gauge",
+              "histogram": "summary"}
+
+
+class MetricsRegistry:
+    """Ordered, lock-consistent metric store.
+
+    All get-or-create accessors take the lock themselves; bulk updates
+    that must be atomic as a GROUP (one ingested event touching several
+    metrics) wrap themselves in ``with registry.lock`` — the accessors
+    use an RLock so both patterns compose.
+    """
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self._metrics: "collections.OrderedDict[str, Any]" = \
+            collections.OrderedDict()
+
+    def _get(self, cls, name: str, help: str, **kw: Any):
+        with self.lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def info(self, name: str, help: str = "") -> Info:
+        return self._get(Info, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  bound: int = 512) -> Histogram:
+        return self._get(Histogram, name, help, bound=bound)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """A consistent point-in-time view of every metric."""
+        with self.lock:
+            return {name: m.snap() for name, m in self._metrics.items()}
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (version 0.0.4) of the registry."""
+        with self.lock:
+            lines: List[str] = []
+            for name, m in self._metrics.items():
+                body = m.render()
+                if not body:
+                    continue
+                if m.help:
+                    lines.append(f"# HELP {_prom_name(name)} {m.help}")
+                lines.append(f"# TYPE {_prom_name(name)} "
+                             f"{_PROM_TYPE[m.kind]}")
+                lines.extend(body)
+            return "\n".join(lines) + ("\n" if lines else "")
+
+
+# --------------------------------------------------------- obs ingester
+
+def _grid_cells(run: Dict[str, Any]) -> Optional[int]:
+    grid = run.get("grid")
+    if isinstance(grid, (list, tuple)) and grid and \
+            all(isinstance(g, int) for g in grid):
+        cells = 1
+        for g in grid:
+            cells *= g
+        ens = run.get("ensemble")
+        if isinstance(ens, int) and ens > 0:
+            cells *= ens
+        return cells
+    return None
+
+
+class RunMetrics:
+    """The obs-event vocabulary, folded into a registry + status payload.
+
+    One instance aggregates an arbitrary MERGED stream of obs records —
+    a single CLI run, or a supervisor log interleaved with its
+    children's logs across restarts, or a whole campaign directory.
+    The first manifest seen is the run's identity; later manifests
+    (child attempts, campaign labels) are counted and tracked as
+    sources.  Every :meth:`ingest` holds the registry lock for the
+    whole record, so a concurrent snapshot sees each event's metrics
+    either fully applied or not at all.
+    """
+
+    def __init__(self, max_chunks: int = 240, max_errors: int = 20):
+        self.registry = MetricsRegistry()
+        self.manifest: Optional[Dict[str, Any]] = None
+        self.manifests_seen = 0
+        self.events_seen = 0
+        self.latest_chunk: Optional[Dict[str, Any]] = None
+        self.chunks_recent: Deque[Dict[str, Any]] = \
+            collections.deque(maxlen=max_chunks)
+        self.costmodel: Optional[Dict[str, Any]] = None
+        self.exchange: Optional[Dict[str, Any]] = None
+        self.heartbeat: Optional[Dict[str, Any]] = None
+        self.summary: Optional[Dict[str, Any]] = None
+        self.launches: List[Dict[str, Any]] = []
+        self.restarts: List[Dict[str, Any]] = []
+        self.give_up: Optional[Dict[str, Any]] = None
+        self.resumed_from_step: Optional[int] = None
+        self.labels: "collections.OrderedDict[str, Dict[str, Any]]" = \
+            collections.OrderedDict()
+        self.errors: Deque[Dict[str, Any]] = \
+            collections.deque(maxlen=max_errors)
+        self._cells: Optional[int] = None
+
+    # -- ingestion ------------------------------------------------------
+
+    def ingest(self, rec: Dict[str, Any]) -> None:
+        """Fold one obs record (manifest or event) into the aggregate.
+
+        Unknown kinds are counted but otherwise ignored — the registry
+        must survive anything a future schema rev appends.  Never
+        raises on a well-formed-but-unexpected record; a malformed one
+        (non-dict fields where dicts are expected) is skipped.
+        """
+        if not isinstance(rec, dict):
+            return
+        with self.registry.lock:
+            try:
+                self._ingest_locked(rec)
+            except Exception:  # noqa: BLE001 — an observer never raises
+                self.registry.counter(
+                    "obs_ingest_errors_total",
+                    "records the ingester could not fold").inc()
+
+    def _ingest_locked(self, rec: Dict[str, Any]) -> None:
+        kind = rec.get("kind")
+        self.events_seen += 1
+        self.registry.counter(
+            "obs_events_total", "obs records ingested").inc()
+        handler = getattr(self, f"_on_{kind}", None)
+        if handler is not None:
+            handler(rec)
+
+    def _on_manifest(self, rec: Dict[str, Any]) -> None:
+        self.manifests_seen += 1
+        self.registry.counter(
+            "obs_manifests_total",
+            "manifests seen (supervised runs: 1 + one per attempt)").inc()
+        if self.manifest is not None:
+            return
+        self.manifest = rec
+        run = rec.get("run") or {}
+        prov = rec.get("provenance") or {}
+        self._cells = _grid_cells(run)
+        self.registry.info(
+            "obs_run_info", "identity of the (primary) run").set(
+            tool=rec.get("tool"), stencil=run.get("stencil"),
+            grid=",".join(map(str, run.get("grid") or [])) or None,
+            mesh=",".join(map(str, run.get("mesh") or [])) or None,
+            backend=prov.get("backend"),
+            device_kind=prov.get("device_kind"),
+            hostname=prov.get("hostname"),
+            process_index=prov.get("process_index"),
+            git_sha=str(prov.get("git_sha", ""))[:12] or None)
+
+    def _on_chunk(self, rec: Dict[str, Any]) -> None:
+        steps = int(rec.get("steps") or 0)
+        wall = float(rec.get("wall_s") or 0.0)
+        ms = rec.get("ms_per_step")
+        self.latest_chunk = rec
+        self.chunks_recent.append(
+            {"chunk": rec.get("chunk"), "steps": steps, "wall_s": wall,
+             "ms_per_step": ms, "recompiled": bool(rec.get("recompiled")),
+             "t": rec.get("t")})
+        self.registry.counter("obs_chunks_total", "chunks completed").inc()
+        self.registry.counter("obs_steps_total",
+                              "real steps completed").inc(steps)
+        if rec.get("recompiled"):
+            self.registry.counter(
+                "obs_recompiles_total",
+                "chunks that recompiled mid-run (shape drift)").inc()
+        first = rec.get("chunk") == 0
+        if first and ms is not None:
+            self.registry.gauge(
+                "obs_first_chunk_ms_per_step",
+                "compile+warmup chunk ms/step").set(ms)
+        if not first and ms is not None and not rec.get("recompiled"):
+            self.registry.histogram(
+                "obs_chunk_ms_per_step",
+                "steady-state ms/step (compile chunk excluded)").observe(ms)
+        if wall > 0 and steps > 0:
+            rate = steps / wall
+            self.registry.gauge("obs_steps_per_s",
+                                "latest chunk steps/s").set(rate)
+            if self._cells:
+                self.registry.gauge(
+                    "obs_gcells_per_s",
+                    "latest chunk throughput, Gcells/s").set(
+                    self._cells * rate / 1e9)
+        mem = rec.get("memory") or {}
+        peak = mem.get("peak_bytes_in_use")
+        if peak is not None:
+            self.registry.gauge(
+                "obs_device_memory_peak_bytes",
+                "max device memory peak over all chunks").set_max(peak)
+        self._update_roofline_gap()
+
+    def _on_costmodel(self, rec: Dict[str, Any]) -> None:
+        self.costmodel = rec
+        roof = rec.get("roofline") or {}
+        t_hbm = roof.get("predicted_ms_per_step_hbm")
+        t_ici = roof.get("predicted_ms_per_step_exchange") or 0.0
+        if t_hbm is not None:
+            self.registry.gauge(
+                "obs_predicted_ms_per_step_overlapped",
+                "roofline ms/step, exchange fully hidden").set(
+                max(t_hbm, t_ici))
+            self.registry.gauge(
+                "obs_predicted_ms_per_step_serial",
+                "roofline ms/step, exchange on the critical path").set(
+                t_hbm + t_ici)
+        self._update_roofline_gap()
+
+    def _update_roofline_gap(self) -> None:
+        """measured p50 / predicted-overlapped — the attribution gap."""
+        roof = (self.costmodel or {}).get("roofline") or {}
+        t_hbm = roof.get("predicted_ms_per_step_hbm")
+        if t_hbm is None:
+            return
+        t_ici = roof.get("predicted_ms_per_step_exchange") or 0.0
+        pred = max(t_hbm, t_ici)
+        steady = sorted(c["ms_per_step"] for c in self.chunks_recent
+                        if c.get("chunk") != 0
+                        and not c.get("recompiled")
+                        and c.get("ms_per_step") is not None)
+        if not steady or pred <= 0:
+            return
+        self.registry.gauge(
+            "obs_roofline_gap_ratio",
+            "measured steady p50 ms/step over the overlapped roofline "
+            "prediction (1.0 = at the roofline)").set(
+            quantile(steady, 0.5) / pred)
+
+    def _on_heartbeat(self, rec: Dict[str, Any]) -> None:
+        self.heartbeat = rec
+        verdict = rec.get("verdict")
+        self.registry.counter("obs_heartbeat_events_total",
+                              "heartbeat verdict events").inc()
+        self.registry.info("obs_heartbeat_verdict",
+                           "latest heartbeat verdict").set(verdict=verdict)
+        self.registry.gauge(
+            "obs_stalled",
+            "1 while the latest heartbeat verdict is STALLED/WEDGED").set(
+            1.0 if verdict in ("STALLED", "WEDGED") else 0.0)
+
+    def _on_launch(self, rec: Dict[str, Any]) -> None:
+        self.launches.append(rec)
+        self.registry.gauge("obs_supervisor_attempts",
+                            "supervised launches so far").set(
+            len(self.launches))
+        step = rec.get("resumed_from_step")
+        if step is not None:
+            self.resumed_from_step = int(step)
+            self.registry.gauge(
+                "obs_resumed_from_step",
+                "checkpoint step the latest attempt resumed from").set(step)
+
+    def _on_restart(self, rec: Dict[str, Any]) -> None:
+        self.restarts.append(rec)
+        self.registry.counter(
+            "obs_supervisor_restarts_total",
+            "supervisor kill+relaunch decisions").inc()
+
+    def _on_give_up(self, rec: Dict[str, Any]) -> None:
+        self.give_up = rec
+        self.registry.gauge(
+            "obs_supervisor_gave_up",
+            "1 once the supervisor stopped restarting").set(1.0)
+
+    def _on_resume(self, rec: Dict[str, Any]) -> None:
+        step = rec.get("resumed_from_step")
+        if step is not None:
+            self.resumed_from_step = int(step)
+            self.registry.gauge(
+                "obs_resumed_from_step",
+                "checkpoint step the latest attempt resumed from").set(step)
+
+    def _on_exchange(self, rec: Dict[str, Any]) -> None:
+        self.exchange = rec
+        self.registry.info(
+            "obs_exchange_mode",
+            "halo-exchange transport and its honest backend tag").set(
+            mode=rec.get("mode"), backend=rec.get("backend"))
+
+    def _on_label(self, rec: Dict[str, Any]) -> None:
+        label = rec.get("label")
+        if not isinstance(label, str):
+            return
+        self.labels[label] = rec
+        self.registry.counter(
+            "obs_campaign_label_events_total",
+            "campaign label progress events").inc()
+
+    def _on_error(self, rec: Dict[str, Any]) -> None:
+        self.errors.append(rec)
+        self.registry.counter("obs_errors_total", "error events").inc()
+
+    def _on_abort(self, rec: Dict[str, Any]) -> None:
+        self.errors.append(rec)
+        self.registry.counter("obs_errors_total", "error events").inc()
+
+    def _on_summary(self, rec: Dict[str, Any]) -> None:
+        self.summary = rec
+        self.registry.gauge("obs_run_complete",
+                            "1 once a summary event landed").set(1.0)
+        mc = rec.get("mcells_per_s")
+        if isinstance(mc, (int, float)):
+            self.registry.gauge("obs_summary_mcells_per_s",
+                                "run-level throughput at exit").set(mc)
+
+    # -- status ---------------------------------------------------------
+
+    def _throughput(self) -> Dict[str, Any]:
+        steady = sorted(c["ms_per_step"] for c in self.chunks_recent
+                        if c.get("chunk") != 0 and not c.get("recompiled")
+                        and c.get("ms_per_step") is not None)
+        out: Dict[str, Any] = {}
+        last = self.chunks_recent[-1] if self.chunks_recent else None
+        if last and last.get("wall_s") and last.get("steps"):
+            rate = last["steps"] / last["wall_s"]
+            out["steps_per_s"] = round(rate, 3)
+            if self._cells:
+                out["gcells_per_s"] = round(self._cells * rate / 1e9, 4)
+        if steady:
+            out["steady_ms_per_step_p50"] = quantile(steady, 0.5)
+            out["steady_ms_per_step_p90"] = quantile(steady, 0.9)
+        return out
+
+    def _campaign(self) -> Optional[Dict[str, Any]]:
+        if not self.labels:
+            return None
+        counts: Dict[str, int] = {}
+        for rec in self.labels.values():
+            status = str(rec.get("status") or "unknown")
+            counts[status] = counts.get(status, 0) + 1
+        return {
+            "counts": counts,
+            "labels": {label: {
+                "status": rec.get("status"),
+                "mcells_per_s": rec.get("mcells_per_s"),
+                "compute": rec.get("compute"),
+                "attempts": rec.get("attempts"),
+                "wall_s": rec.get("wall_s"),
+                "error": rec.get("error"),
+            } for label, rec in self.labels.items()},
+        }
+
+    def status(self) -> Dict[str, Any]:
+        """The ``/status.json`` payload: one consistent dict.
+
+        Everything a remote "is it wedged?" needs without reading any
+        log file: provenance, the latest chunk, the heartbeat verdict,
+        and the supervisor restart trail (launches carry
+        ``resumed_from_step``).
+        """
+        with self.registry.lock:
+            hb = self.heartbeat
+            verdict = hb.get("verdict") if hb else None
+            out: Dict[str, Any] = {
+                "generated_at": time.time(),
+                "manifest": self.manifest,
+                "manifests_seen": self.manifests_seen,
+                "events_seen": self.events_seen,
+                "verdict": verdict or ("DONE" if self.summary else "ALIVE"),
+                "latest_chunk": self.latest_chunk,
+                "chunks_recent": list(self.chunks_recent),
+                "throughput": self._throughput(),
+                "heartbeat": hb,
+                "launches": list(self.launches),
+                "restarts": list(self.restarts),
+                "give_up": self.give_up,
+                "resumed_from_step": self.resumed_from_step,
+                "exchange": self.exchange,
+                "summary": self.summary,
+                "errors": list(self.errors),
+            }
+            roof = (self.costmodel or {}).get("roofline")
+            if roof:
+                out["roofline"] = roof
+            campaign = self._campaign()
+            if campaign:
+                out["campaign"] = campaign
+            return out

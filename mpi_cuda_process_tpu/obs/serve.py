@@ -1,0 +1,321 @@
+"""Live run console: /metrics + /status.json + /events over the trace.
+
+``obs/metrics.py`` turns the telemetry event stream into an aggregate;
+this module puts an HTTP face on it so a multi-hour run on the flaky
+tunnel is observable from OUTSIDE the box — "is it wedged?" becomes a
+``curl``, not a log read.  Three endpoints:
+
+* ``GET /metrics``     — Prometheus text exposition of the registry
+  (steps/s, Gcells/s, compile vs steady split, recompiles, memory
+  peak, heartbeat verdict, supervisor restarts, roofline gap);
+* ``GET /status.json`` — the structured answer: manifest provenance,
+  latest chunk stats, heartbeat verdict, and the supervisor restart
+  trail with ``resumed_from_step``;
+* ``GET /events?after=SEQ[&wait=S]`` — incremental NDJSON tail of the
+  merged event stream (each record annotated with ``_seq``); with
+  ``wait`` the request long-polls (bounded — see ``MAX_WAIT_S``) until
+  a new event lands or the wait expires.  This is the transport the
+  ROADMAP item-2 request handles will stream chunk telemetry over.
+
+Design constraints, inherited from the obs layer:
+
+* **The server never blocks the run loop.**  The run only ever writes
+  its JSONL trace (exactly as before); a poller thread tails the
+  file(s) with the supervisor's complete-lines-only
+  :class:`~.trace.LogTail` and folds records into the registry.
+  Endpoint handlers read ONLY registry snapshots and the bounded event
+  buffer — no handler can touch the run, and ``--serve`` adds zero ops
+  to the jitted step (the telemetry-invariance pin extends to a served
+  run; a test scrapes mid-run to hold the no-blocking claim).
+* **A console can watch many logs.**  The supervisor watches its own
+  log plus each attempt's child log, so a supervised run is
+  monitorable across restarts through ONE address; the campaign
+  aggregator (:func:`serve_campaign`) rescans a directory of manifests
+  and exposes per-label progress for ``benchmarks/measure.py``.
+* **Clean shutdown.**  :meth:`ObsServer.close` drains one final poll,
+  stops the HTTP loop, and joins its threads (all named
+  ``obs-serve*``) — a run exiting must leak nothing (pinned by the
+  tier-1 smoke).
+
+Pure stdlib.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from . import metrics as metrics_lib
+from .trace import LogTail
+
+# Long-poll ceiling for /events?wait=S: bounded so a dying client can
+# never pin a handler thread for long (and the tier-1 smoke's leak
+# check stays meaningful).
+MAX_WAIT_S = 25.0
+MAX_EVENT_BATCH = 5000
+
+
+class RunConsole:
+    """The state behind the endpoints: tailed logs -> registry + buffer.
+
+    ``watch(path)`` registers a JSONL trace (idempotent; the file may
+    not exist yet — ``LogTail`` treats a missing file as empty).
+    ``poll()`` drains every tail in registration order, assigns each
+    new record a monotonically increasing ``seq``, folds it into
+    :class:`~.metrics.RunMetrics`, and wakes long-poll waiters.
+    """
+
+    def __init__(self, max_events: int = 4096):
+        self.metrics = metrics_lib.RunMetrics()
+        self._cond = threading.Condition()
+        self._tails: List[Tuple[str, LogTail]] = []
+        self._watched: set = set()
+        self._events: "collections.deque" = \
+            collections.deque(maxlen=max_events)
+        self.seq = 0  # seq of the newest buffered record (1-based)
+        self.closed = False
+
+    def close(self) -> None:
+        """Wake every parked long-poll so shutdown never waits on one."""
+        with self._cond:
+            self.closed = True
+            self._cond.notify_all()
+
+    def watch(self, path: str) -> None:
+        path = os.path.abspath(path)
+        with self._cond:
+            if path in self._watched:
+                return
+            self._watched.add(path)
+            self._tails.append((path, LogTail(path)))
+
+    def watched(self) -> List[str]:
+        with self._cond:
+            return [p for p, _ in self._tails]
+
+    def poll(self) -> int:
+        """Drain all tails once; returns the number of new records."""
+        with self._cond:
+            tails = list(self._tails)
+        new: List[Dict[str, Any]] = []
+        for _path, tail in tails:
+            new.extend(tail.poll())
+        if not new:
+            return 0
+        for rec in new:
+            self.metrics.ingest(rec)
+        with self._cond:
+            for rec in new:
+                self.seq += 1
+                self._events.append((self.seq, rec))
+            self._cond.notify_all()
+        return len(new)
+
+    def events_after(self, after: int, limit: int = 1000,
+                     wait_s: float = 0.0) -> List[Tuple[int, Dict[str, Any]]]:
+        """Buffered records with seq > ``after`` (oldest first).
+
+        With ``wait_s`` > 0 and nothing newer buffered, blocks until a
+        new record lands or the (clamped) wait expires — the bounded
+        long-poll.  Records older than the buffer are gone (the buffer
+        is bounded); callers see the gap as a seq jump, never stale
+        data replayed.
+        """
+        limit = max(1, min(int(limit), MAX_EVENT_BATCH))
+        wait_s = max(0.0, min(float(wait_s), MAX_WAIT_S))
+        deadline = time.monotonic() + wait_s
+        with self._cond:
+            while True:
+                out = [(s, r) for s, r in self._events if s > after]
+                if out or wait_s <= 0 or self.closed:
+                    return out[:limit]
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._cond.wait(min(remaining, 0.5))
+
+
+class CampaignConsole(RunConsole):
+    """Aggregator: every ``*.jsonl`` under a directory, rescanned live.
+
+    The measure.py campaign view: the harness's own log (label events)
+    plus any manifest a child run drops into the telemetry dir — new
+    files are picked up between polls, so labels launched after the
+    server started still appear.
+    """
+
+    def __init__(self, directory: str, max_events: int = 4096):
+        super().__init__(max_events=max_events)
+        self.directory = os.path.abspath(directory)
+        self._rescan()
+
+    def _rescan(self) -> None:
+        try:
+            names = sorted(os.listdir(self.directory))
+        except OSError:
+            return
+        for name in names:
+            if name.endswith(".jsonl"):
+                self.watch(os.path.join(self.directory, name))
+
+    def poll(self) -> int:
+        self._rescan()
+        return super().poll()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "obs-serve/1"
+    protocol_version = "HTTP/1.1"
+
+    # the run's stderr is the run's; access logs would drown it
+    def log_message(self, *args: Any) -> None:
+        pass
+
+    @property
+    def console(self) -> RunConsole:
+        return self.server.console  # type: ignore[attr-defined]
+
+    def _reply(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        try:
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away; nothing to clean up
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server API
+        try:
+            url = urlparse(self.path)
+            route = url.path.rstrip("/") or "/"
+            if route == "/metrics":
+                self._reply(200,
+                            self.console.metrics.registry.to_prometheus(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+            elif route in ("/status.json", "/status"):
+                body = json.dumps(self.console.metrics.status(),
+                                  default=str)
+                self._reply(200, body, "application/json")
+            elif route == "/events":
+                self._events(url)
+            elif route == "/":
+                self._reply(200,
+                            "obs live console\n"
+                            "  /metrics      Prometheus text\n"
+                            "  /status.json  provenance + latest chunk + "
+                            "heartbeat + restart trail\n"
+                            "  /events?after=SEQ&wait=S  incremental "
+                            "NDJSON tail (bounded long-poll)\n",
+                            "text/plain; charset=utf-8")
+            else:
+                self._reply(404, f"no route {route!r}\n",
+                            "text/plain; charset=utf-8")
+        except Exception as e:  # noqa: BLE001 — a handler never kills
+            try:
+                self._reply(500, f"{type(e).__name__}: {e}\n",
+                            "text/plain; charset=utf-8")
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _events(self, url) -> None:
+        qs = parse_qs(url.query)
+
+        def _num(key: str, default: float, cast) -> Any:
+            try:
+                return cast(qs[key][0])
+            except (KeyError, IndexError, ValueError):
+                return default
+
+        after = _num("after", 0, int)
+        wait_s = _num("wait", 0.0, float)
+        limit = _num("limit", 1000, int)
+        out = self.console.events_after(after, limit=limit, wait_s=wait_s)
+        body = "".join(json.dumps({**rec, "_seq": seq}, default=str) + "\n"
+                       for seq, rec in out)
+        self._reply(200, body, "application/x-ndjson")
+
+
+class ObsServer:
+    """A ThreadingHTTPServer + log-poller pair around one console."""
+
+    def __init__(self, console: RunConsole, port: int = 0,
+                 host: str = "127.0.0.1", poll_s: float = 0.25):
+        self.console = console
+        self.poll_s = float(poll_s)
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.console = console  # type: ignore[attr-defined]
+        self.host, self.port = self._httpd.server_address[:2]
+        self._stop = threading.Event()
+        self._closed = False
+        console.poll()  # manifest visible before the first scrape
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-serve-http",
+            daemon=True)
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="obs-serve-poll", daemon=True)
+        self._http_thread.start()
+        self._poll_thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.console.poll()
+            except Exception:  # noqa: BLE001 — the watcher must survive
+                pass            # anything a dying writer leaves behind
+
+    def close(self, join_timeout_s: float = 5.0) -> None:
+        """Stop serving and join the threads.  Idempotent, never raises
+        (runs on the teardown path of the run it watched)."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._stop.set()
+            self._poll_thread.join(join_timeout_s)
+            try:
+                self.console.poll()  # final drain: the summary event
+            except Exception:  # noqa: BLE001
+                pass
+            self.console.close()  # wake parked long-polls (empty reply)
+            self._httpd.shutdown()
+            self._http_thread.join(join_timeout_s)
+            self._httpd.server_close()
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass
+
+    def __enter__(self) -> "ObsServer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+def serve_run(log_path: str, port: int = 0, host: str = "127.0.0.1",
+              poll_s: float = 0.25,
+              extra_logs: Optional[List[str]] = None) -> ObsServer:
+    """Serve one run's telemetry log (plus optional siblings)."""
+    console = RunConsole()
+    console.watch(log_path)
+    for p in extra_logs or ():
+        console.watch(p)
+    return ObsServer(console, port=port, host=host, poll_s=poll_s)
+
+
+def serve_campaign(directory: str, port: int = 0, host: str = "127.0.0.1",
+                   poll_s: float = 0.5) -> ObsServer:
+    """Serve a directory of manifests (the campaign aggregator)."""
+    return ObsServer(CampaignConsole(directory), port=port, host=host,
+                     poll_s=poll_s)

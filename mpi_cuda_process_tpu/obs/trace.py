@@ -31,12 +31,20 @@ from __future__ import annotations
 import json
 import os
 import re
+import socket
 import subprocess
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-SCHEMA_VERSION = 1
+# Schema 2 (round 14) adds multi-host provenance — process_index /
+# process_count / hostname — the fields a future aggregator needs to
+# merge per-host status pages (ROADMAP item 5 prep).  The validator
+# accepts BOTH revisions: new manifests are written at SCHEMA_VERSION,
+# old schema-1 logs (without the host fields) still parse, and the
+# "bump the reader, never the record" rule holds.
+SCHEMA_VERSION = 2
+ACCEPTED_SCHEMAS = (1, 2)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -50,6 +58,14 @@ _PROVENANCE_TYPES = {
     "device_kind": str,
     "device_count": int,
     "framework_version": str,
+}
+
+# schema-2 additions: REQUIRED on schema-2 manifests, absent on schema-1
+# (type-checked when a schema-1 writer chose to include them anyway)
+_PROVENANCE_V2_TYPES = {
+    "process_index": int,
+    "process_count": int,
+    "hostname": str,
 }
 
 
@@ -102,6 +118,18 @@ def provenance() -> Dict[str, Any]:
         device_count = len(devs)
     except Exception:  # noqa: BLE001 — a wedged backend must not block
         device_kind, device_count = "unknown", 1
+    try:
+        # the multi-host identity (schema 2): which process of how many
+        # wrote this manifest — what lets an aggregator merge per-host
+        # status pages instead of guessing from filenames
+        process_index = int(jax.process_index())
+        process_count = int(jax.process_count())
+    except Exception:  # noqa: BLE001 — same wedged-backend discipline
+        process_index, process_count = 0, 1
+    try:
+        hostname = socket.gethostname() or "unknown"
+    except Exception:  # noqa: BLE001
+        hostname = "unknown"
     return {
         "git_sha": _git_sha(),
         "builder_rev": _builder_rev(),
@@ -109,6 +137,9 @@ def provenance() -> Dict[str, Any]:
         "backend": jax.default_backend(),
         "device_kind": device_kind,
         "device_count": device_count,
+        "process_index": process_index,
+        "process_count": process_count,
+        "hostname": hostname,
         "framework_version": __version__,
     }
 
@@ -140,10 +171,10 @@ def validate_manifest(m: Any) -> Dict[str, Any]:
     problems: List[str] = []
     if not isinstance(m, dict):
         raise ValueError(f"manifest must be a dict, got {type(m).__name__}")
-    if m.get("schema") != SCHEMA_VERSION:
+    if m.get("schema") not in ACCEPTED_SCHEMAS:
         problems.append(
-            f"schema must be {SCHEMA_VERSION} (got {m.get('schema')!r}); "
-            "bump the reader, never the record")
+            f"schema must be one of {ACCEPTED_SCHEMAS} "
+            f"(got {m.get('schema')!r}); bump the reader, never the record")
     if m.get("kind") != "manifest":
         problems.append(f"kind must be 'manifest' (got {m.get('kind')!r})")
     if not isinstance(m.get("tool"), str) or not m.get("tool"):
@@ -164,8 +195,24 @@ def validate_manifest(m: Any) -> Dict[str, Any]:
                 problems.append(
                     f"provenance.{key} must be {typ.__name__} "
                     f"(got {prov.get(key)!r})")
+        # schema 2 requires the multi-host identity; a schema-1 manifest
+        # predates it (still parses), but when present the types bind
+        for key, typ in _PROVENANCE_V2_TYPES.items():
+            present = key in prov
+            if m.get("schema") == 2 and not present:
+                problems.append(
+                    f"provenance.{key} is required at schema 2 "
+                    f"({typ.__name__})")
+            elif present and not isinstance(prov.get(key), typ):
+                problems.append(
+                    f"provenance.{key} must be {typ.__name__} "
+                    f"(got {prov.get(key)!r})")
         if prov.get("device_count", 0) < 1:
             problems.append("provenance.device_count must be >= 1")
+        if "process_count" in prov and \
+                isinstance(prov.get("process_count"), int) and \
+                prov["process_count"] < 1:
+            problems.append("provenance.process_count must be >= 1")
         br = prov.get("builder_rev", None)
         if br is not None and not isinstance(br, int):
             problems.append(
@@ -180,8 +227,8 @@ def validate_event(e: Any) -> Dict[str, Any]:
     if not isinstance(e, dict):
         raise ValueError(f"event must be a dict, got {type(e).__name__}")
     problems: List[str] = []
-    if e.get("schema") != SCHEMA_VERSION:
-        problems.append(f"schema must be {SCHEMA_VERSION} "
+    if e.get("schema") not in ACCEPTED_SCHEMAS:
+        problems.append(f"schema must be one of {ACCEPTED_SCHEMAS} "
                         f"(got {e.get('schema')!r})")
     kind = e.get("kind")
     if not isinstance(kind, str) or not kind:

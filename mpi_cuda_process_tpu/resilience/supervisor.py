@@ -361,7 +361,7 @@ def run_supervised(cfg) -> int:
         trace_lib.default_telemetry_dir(), f"supervise-{tag}.jsonl")
     child_cfg = dataclasses.replace(
         cfg, supervise=False, checkpoint_dir=checkpoint_dir,
-        checkpoint_every=checkpoint_every)
+        checkpoint_every=checkpoint_every, serve_port=None)
 
     session = None
     try:
@@ -377,6 +377,31 @@ def run_supervised(cfg) -> int:
         log.warning("supervisor telemetry disabled (%s: %s)",
                     type(e).__name__, e)
 
+    # Live console (--serve, obs/serve.py): ONE address for the whole
+    # supervised run.  The console watches the supervisor's own log
+    # (launch/restart trail) plus every attempt's child log as it is
+    # launched, so /status.json answers "is it wedged?" ACROSS restarts
+    # — the restart trail, the child's heartbeat verdict, and
+    # resumed_from_step through a single port.  The child itself never
+    # serves (serve_port is launcher-only and to_argv drops it).
+    server = None
+    if cfg.serve_port is not None:
+        try:
+            from ..obs import serve as serve_lib
+
+            console = serve_lib.RunConsole()
+            console.watch(sibling_path(telemetry_base, "supervisor"))
+            server = serve_lib.ObsServer(console, port=cfg.serve_port)
+            log.info("supervisor obs console serving at %s", server.url)
+            if session is not None:
+                session.event("serve", url=server.url, port=server.port,
+                              endpoints=["/metrics", "/status.json",
+                                         "/events"])
+        except Exception as e:  # noqa: BLE001 — never load-bearing
+            log.warning("supervisor --serve disabled (%s: %s)",
+                        type(e).__name__, e)
+            server = None
+
     def launcher(attempt: int, resume: bool):
         tel = sibling_path(telemetry_base, f"attempt{attempt}")
         argv = to_argv(dataclasses.replace(
@@ -386,6 +411,10 @@ def run_supervised(cfg) -> int:
                  f" (resume from step "
                  f"{latest_checkpoint_step(checkpoint_dir)})"
                  if resume else "")
+        if server is not None:
+            # the console follows the child across restarts: each
+            # attempt's log joins the merged stream before the spawn
+            server.console.watch(tel)
         handle = spawn_child(
             [sys.executable, "-m", "mpi_cuda_process_tpu", *argv],
             attempt=attempt)
@@ -401,6 +430,8 @@ def run_supervised(cfg) -> int:
     finally:
         if session is not None:
             session.close()
+        if server is not None:
+            server.close()
     if res.ok:
         log.info("supervisor: run completed after %d attempt(s)%s",
                  res.attempts,

@@ -22,10 +22,18 @@ a tool drifting off-schema fails the gate.  A pallas-retry sibling
 (``PATH.retry.jsonl``, written by cli.run's auto-retry) is validated
 against the same schema when present.
 
+A ``tool="supervisor"`` log has no chunk events (its children's logs
+carry those), so instead of an empty attribution table it renders the
+launch/restart/give-up trail with ``resumed_from_step``.  ``--ledger``
+is the campaign-state mode: the ``best_known`` table per label x
+backend plus quarantine counts and reasons, straight from
+``benchmarks/ledger.jsonl`` (or a path you pass).
+
 Safe on a wedged box: the CPU backend is forced before any jax use and
 nothing here touches a device.
 
 Usage:  python scripts/obs_report.py PATH [--check]
+        python scripts/obs_report.py --ledger [PATH]
 """
 
 from __future__ import annotations
@@ -33,6 +41,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
@@ -202,12 +211,68 @@ def _runtime_block(summary) -> str:
     return "\n".join(lines)
 
 
+def _supervisor_trail_block(events) -> str:
+    """The launch/restart/give-up trail of a ``tool="supervisor"`` log.
+
+    A supervisor log has no chunk or costmodel events (the CHILD's logs
+    carry those), so the attribution table used to render empty and
+    misleading; the trail — which attempt launched when, why each was
+    killed, where each resume picked up — is the story this log
+    actually tells.
+    """
+    rows = []
+    for e in events:
+        kind = e.get("kind")
+        if kind == "launch":
+            what = "resume" if e.get("resume") else "fresh start"
+            rows.append([f"{e['t']:.0f}", e.get("attempt"), "launch",
+                         e.get("resumed_from_step")
+                         if e.get("resumed_from_step") is not None
+                         else "-", what])
+        elif kind == "restart":
+            rows.append([f"{e['t']:.0f}", e.get("attempt"), "restart",
+                         e.get("checkpoint_step")
+                         if e.get("checkpoint_step") is not None else "-",
+                         f"{e.get('reason', '?')} "
+                         f"(backoff {e.get('backoff_s', '?')}s)"])
+        elif kind == "give_up":
+            rows.append([f"{e['t']:.0f}", "-", "GIVE UP", "-",
+                         f"{e.get('reason', '?')} after "
+                         f"{e.get('attempts', '?')} attempt(s)"])
+    launches = sum(1 for e in events if e.get("kind") == "launch")
+    restarts = sum(1 for e in events if e.get("kind") == "restart")
+    head = (f"supervisor trail ({launches} launch(es), "
+            f"{restarts} restart(s))")
+    if not rows:
+        return head + ": no launch events (did the supervisor start?)"
+    return head + "\n" + _table(
+        rows, ["t", "attempt", "event", "ckpt/resume step", "detail"])
+
+
 def render(path: str) -> str:
     manifest, events = obs_trace.read_log(path)
     by_kind: dict = {}
     for e in events:
         by_kind.setdefault(e.get("kind"), []).append(e)
     out = [_manifest_block(manifest)]
+
+    if manifest.get("tool") == "supervisor":
+        # a supervisor log has no chunks to attribute — render the
+        # restart trail, then the generic summary/heartbeat blocks
+        out.append(_supervisor_trail_block(events))
+        summary = (by_kind.get("summary") or [None])[-1]
+        if summary:
+            bits = [f"{k}={summary[k]}" for k in
+                    ("ok", "attempts", "restarts", "gave_up",
+                     "resumed_from_step") if k in summary]
+            out.append("supervisor summary: " + "  ".join(bits))
+        errors = by_kind.get("error") or []
+        for e in errors:
+            out.append(f"ERROR: {e.get('error')}")
+        if not summary and not errors:
+            out.append("(no summary event — the supervisor is live or "
+                       "was killed; the trail above is the state)")
+        return "\n\n".join(out)
 
     cost = (by_kind.get("costmodel") or [None])[-1]
     summary = (by_kind.get("summary") or [None])[-1]
@@ -261,14 +326,71 @@ def render(path: str) -> str:
     return "\n\n".join(out)
 
 
+def _ledger_summary(path) -> str:
+    """``--ledger``: campaign state in one command.
+
+    The ``best_known`` table per label x backend (structurally unable
+    to surface a quarantined row) plus the quarantine counts and
+    reasons — what used to take hand-grepping benchmarks/ledger.jsonl.
+    """
+    from mpi_cuda_process_tpu.obs import ledger as ledger_lib
+
+    path = path or ledger_lib.default_ledger_path()
+    rows = ledger_lib.read_rows(path)
+    best = ledger_lib.best_known(rows)
+    quarantined = [r for r in rows if r.get("status") == "quarantined"]
+    out = [f"ledger {path}: {len(rows)} rows "
+           f"({len(quarantined)} quarantined), "
+           f"{len(best)} best-known baselines"]
+    trows = []
+    for bk in sorted(best):
+        r = best[bk]
+        q = sum(1 for row in quarantined
+                if ledger_lib.baseline_key(row) == bk)
+        ts = r.get("measured_at")
+        trows.append([bk, r["value"], r["unit"],
+                      time.strftime("%Y-%m-%d",
+                                    time.localtime(ts)) if ts else "-",
+                      q, r["source"][:44]])
+    if trows:
+        out.append(_table(trows, ["label|backend", "best", "unit",
+                                  "measured", "quarantined", "source"]))
+    reasons: dict = {}
+    for r in quarantined:
+        key = str(r.get("quarantine") or "?").split(":")[0]
+        reasons[key] = reasons.get(key, 0) + 1
+    if reasons:
+        out.append("quarantine reasons:\n" + "\n".join(
+            f"  {n:4d}  {reason}"
+            for reason, n in sorted(reasons.items(),
+                                    key=lambda kv: -kv[1])))
+    return "\n\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("log", help="telemetry JSONL path")
+    ap.add_argument("log", nargs="?", default=None,
+                    help="telemetry JSONL path (or, with --ledger, a "
+                         "ledger path; defaults to the committed "
+                         "benchmarks/ledger.jsonl there)")
     ap.add_argument("--check", action="store_true",
                     help="validate the manifest and every event against "
                          "the shared schema; exit nonzero on any "
                          "invalid record (the tier-1 smoke mode)")
+    ap.add_argument("--ledger", action="store_true",
+                    help="summary mode for a campaign ledger: the "
+                         "best_known table per label x backend plus "
+                         "quarantine counts + reasons")
     a = ap.parse_args(argv)
+    if a.ledger:
+        try:
+            print(_ledger_summary(a.log))
+        except (ValueError, OSError) as e:
+            print(f"obs_report --ledger: {e}", file=sys.stderr)
+            return 1
+        return 0
+    if not a.log:
+        ap.error("a telemetry JSONL path is required (or use --ledger)")
     if a.check:
         # the pallas auto-retry writes its own log at PATH.retry.jsonl
         # (cli.run); when present it must pass the same schema — a

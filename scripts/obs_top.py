@@ -1,0 +1,326 @@
+#!/usr/bin/env python
+"""Terminal live monitor: one refreshing screen per run (or campaign).
+
+The human face of the live-observability layer (``obs/serve.py``):
+point it at
+
+* a **URL** (``http://host:port`` from ``--serve``) — polls
+  ``/status.json`` and renders the remote run live;
+* a **telemetry JSONL path** — re-reads the log each refresh and
+  renders the same view locally (works on a finished or in-flight log,
+  no server needed);
+* a **ledger JSONL path** (e.g. the committed
+  ``benchmarks/ledger.jsonl``) — renders the campaign state:
+  ``best_known`` per label x backend plus quarantine counts/reasons.
+
+One screen: run header (what/where/provenance), a throughput sparkline
+over the recent chunks, the predicted-vs-measured roofline line, the
+heartbeat/restart status ("is it wedged?" at a glance), and — for
+campaign logs — the per-label table with deltas against the ledger's
+``best_known`` baselines.
+
+``--once`` renders a single frame and exits (scripts/CI); the default
+loop clears and redraws every ``--interval`` seconds until Ctrl-C.
+
+Safe on a wedged box: CPU is forced before any jax-touching import and
+nothing here contacts a device.
+
+Usage:  python scripts/obs_top.py URL|PATH [--interval S] [--once]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from cpuforce import force_cpu  # noqa: E402
+
+force_cpu()  # before the package (and hence any jax backend) loads
+
+from mpi_cuda_process_tpu.obs import ledger as ledger_lib  # noqa: E402
+from mpi_cuda_process_tpu.obs import metrics as metrics_lib  # noqa: E402
+from mpi_cuda_process_tpu.obs import trace as trace_lib  # noqa: E402
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 48) -> str:
+    """Unicode sparkline of the last ``width`` values (min-max scaled)."""
+    vals = [float(v) for v in values if v is not None][-width:]
+    if not vals:
+        return "(no samples yet)"
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[3] * len(vals)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1,
+                   int((v - lo) / span * (len(_SPARK) - 1) + 0.5))]
+        for v in vals)
+
+
+def _age(ts) -> str:
+    if not isinstance(ts, (int, float)):
+        return "-"
+    s = max(0.0, time.time() - ts)
+    if s < 90:
+        return f"{s:.0f}s ago"
+    if s < 5400:
+        return f"{s / 60:.0f}m ago"
+    return f"{s / 3600:.1f}h ago"
+
+
+def _table(rows, header):
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    out = ["  ".join(str(h).ljust(w) for h, w in zip(header, widths))]
+    out.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+# ------------------------------------------------------------ run frame
+
+def _header_lines(status) -> list:
+    m = status.get("manifest") or {}
+    run = m.get("run") or {}
+    prov = m.get("provenance") or {}
+    grid = "x".join(map(str, run.get("grid") or [])) or "-"
+    mesh = "x".join(map(str, run.get("mesh") or [])) or "-"
+    host = prov.get("hostname") or "?"
+    pidx = prov.get("process_index")
+    pcnt = prov.get("process_count")
+    proc = f"  p{pidx}/{pcnt}" if pidx is not None else ""
+    lines = [
+        f"run   tool={m.get('tool', '?')}  "
+        f"stencil={run.get('stencil', '-')}  grid={grid}  mesh={mesh}  "
+        f"backend={prov.get('backend', '?')} "
+        f"({prov.get('device_count', '?')}x "
+        f"{prov.get('device_kind', '?')})",
+        f"      host={host}{proc}  "
+        f"git={str(prov.get('git_sha', '?'))[:12]}  "
+        f"jax={prov.get('jax_version', '?')}  "
+        f"started {_age(m.get('created_at'))}  "
+        f"events={status.get('events_seen', 0)}",
+    ]
+    flags = [k for k in ("overlap", "pipeline", "supervise") if run.get(k)]
+    extra = []
+    if run.get("fuse"):
+        extra.append(f"fuse={run['fuse']}({run.get('fuse_kind', 'auto')})")
+    if run.get("exchange") and run.get("exchange") != "ppermute":
+        extra.append(f"exchange={run['exchange']}")
+    extra += flags
+    if extra:
+        lines.append("      " + "  ".join(extra))
+    return lines
+
+
+def _throughput_lines(status) -> list:
+    chunks = status.get("chunks_recent") or []
+    rates = [c["steps"] / c["wall_s"] for c in chunks
+             if c.get("wall_s") and c.get("steps")]
+    tp = status.get("throughput") or {}
+    bits = []
+    if "steps_per_s" in tp:
+        bits.append(f"{tp['steps_per_s']:g} steps/s")
+    if "gcells_per_s" in tp:
+        bits.append(f"{tp['gcells_per_s']:g} Gcells/s")
+    if "steady_ms_per_step_p50" in tp:
+        bits.append(f"steady p50 {tp['steady_ms_per_step_p50']:.4g} "
+                    f"ms/step (p90 {tp.get('steady_ms_per_step_p90', 0):.4g})")
+    lines = [f"rate  {sparkline(rates)}  "
+             + ("  ".join(bits) if bits else "(no chunks yet)")]
+    roof = status.get("roofline") or {}
+    t_hbm = roof.get("predicted_ms_per_step_hbm")
+    if t_hbm is not None:
+        t_ici = roof.get("predicted_ms_per_step_exchange") or 0.0
+        pred = max(t_hbm, t_ici)
+        line = (f"roof  predicted {pred:.4g} (overlapped) / "
+                f"{t_hbm + t_ici:.4g} (serial) ms/step")
+        measured = tp.get("steady_ms_per_step_p50")
+        if measured is not None and pred > 0:
+            line += (f" — measured p50 {measured:.4g} "
+                     f"(gap {measured / pred:.2f}x)")
+        lines.append(line)
+    return lines
+
+
+def _health_lines(status) -> list:
+    hb = status.get("heartbeat") or {}
+    chunk = status.get("latest_chunk") or {}
+    bits = [f"verdict={status.get('verdict', '?')}"]
+    if chunk:
+        bits.append(f"chunk {chunk.get('chunk')} "
+                    f"({_age(chunk.get('t'))})")
+    restarts = status.get("restarts") or []
+    if status.get("launches"):
+        bits.append(f"attempts={len(status['launches'])}")
+    if restarts:
+        bits.append(f"restarts={len(restarts)}")
+    if status.get("resumed_from_step") is not None:
+        bits.append(f"resumed_from_step={status['resumed_from_step']}")
+    if status.get("give_up"):
+        bits.append("GAVE UP")
+    lines = ["health  " + "  ".join(bits)]
+    if hb.get("detail") and hb.get("verdict") not in (None, "RECOVERED"):
+        lines.append(f"        {str(hb['detail'])[:100]}")
+    for r in restarts[-3:]:
+        lines.append(f"        restart: {r.get('reason', '?')} "
+                     f"(backoff {r.get('backoff_s', '?')}s, "
+                     f"checkpoint {r.get('checkpoint_step')})")
+    summary = status.get("summary")
+    if summary:
+        bits = [f"{k}={summary[k]}" for k in
+                ("ok", "steps", "mcells_per_s", "converged", "labels_run")
+                if k in summary]
+        lines.append("done    " + ("  ".join(bits) if bits else "summary"))
+    for e in (status.get("errors") or [])[-2:]:
+        lines.append(f"ERROR   {str(e.get('error') or e.get('reason'))[:100]}")
+    return lines
+
+
+def _campaign_lines(status, ledger_path) -> list:
+    camp = status.get("campaign")
+    if not camp:
+        return []
+    best = {}
+    try:
+        best = ledger_lib.best_known(ledger_lib.read_rows(ledger_path))
+    except Exception:  # noqa: BLE001 — the monitor renders anyway
+        pass
+    backend = ((status.get("manifest") or {}).get("provenance")
+               or {}).get("backend")
+    counts = "  ".join(f"{k}={v}"
+                       for k, v in sorted(camp["counts"].items()))
+    rows = []
+    for label, rec in camp["labels"].items():
+        bk = best.get(f"{label}|{backend}")
+        base = bk["value"] if bk else None
+        val = rec.get("mcells_per_s")
+        if isinstance(val, (int, float)) and isinstance(base, (int, float)) \
+                and base > 0:
+            delta = f"{(val / base - 1) * 100:+.1f}%"
+        else:
+            delta = "-"
+        rows.append([
+            label, rec.get("status") or "-",
+            val if val is not None else "-",
+            base if base is not None else "-", delta,
+            (str(rec.get("error") or "")[:36])])
+    return [f"campaign ({len(rows)} labels: {counts})",
+            _table(rows, ["label", "status", "Mcells/s",
+                          "best_known", "delta", "error"])]
+
+
+def run_frame(status, ledger_path) -> str:
+    lines = _header_lines(status)
+    lines += _throughput_lines(status)
+    lines += _health_lines(status)
+    lines += _campaign_lines(status, ledger_path)
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------- ledger frame
+
+def ledger_frame(path) -> str:
+    rows = ledger_lib.read_rows(path)
+    best = ledger_lib.best_known(rows)
+    quarantined = [r for r in rows if r.get("status") == "quarantined"]
+    reasons = {}
+    for r in quarantined:
+        key = str(r.get("quarantine") or "?").split(":")[0]
+        reasons[key] = reasons.get(key, 0) + 1
+    out = [f"ledger {path}: {len(rows)} rows "
+           f"({len(quarantined)} quarantined), {len(best)} baselines"]
+    trows = []
+    for bk in sorted(best):
+        r = best[bk]
+        trows.append([bk, r["value"], r["unit"],
+                      _age(r.get("measured_at")), r["source"][:40]])
+    if trows:
+        out.append(_table(trows, ["label|backend", "best", "unit",
+                                  "measured", "source"]))
+    if reasons:
+        out.append("quarantine reasons:")
+        for k, v in sorted(reasons.items(), key=lambda kv: -kv[1]):
+            out.append(f"  {v:4d}  {k}")
+    return "\n".join(out)
+
+
+# -------------------------------------------------------------- sources
+
+def _status_from_url(url: str):
+    base = url.rstrip("/")
+    with urllib.request.urlopen(base + "/status.json", timeout=10) as r:
+        return json.load(r)
+
+
+def _status_from_log(path: str):
+    manifest, events = trace_lib.read_log(path)
+    rm = metrics_lib.RunMetrics()
+    rm.ingest(manifest)
+    for e in events:
+        rm.ingest(e)
+    return rm.status()
+
+
+def _is_ledger(path: str) -> bool:
+    try:
+        with open(path) as fh:
+            first = fh.readline().strip()
+        return bool(first) and \
+            json.loads(first).get("kind") == "ledger_row"
+    except (OSError, ValueError):
+        return False
+
+
+def frame(source: str, ledger_path: str) -> str:
+    if source.startswith(("http://", "https://")):
+        return run_frame(_status_from_url(source), ledger_path)
+    if _is_ledger(source):
+        return ledger_frame(source)
+    return run_frame(_status_from_log(source), ledger_path)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("source",
+                    help="http://host:port (a --serve console), a "
+                         "telemetry JSONL path, or a ledger JSONL path")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no clear, no loop)")
+    ap.add_argument("--ledger", default=None,
+                    help="ledger path for campaign best_known deltas "
+                         f"(default {ledger_lib.default_ledger_path()})")
+    a = ap.parse_args(argv)
+    ledger_path = a.ledger or ledger_lib.default_ledger_path()
+    if a.once:
+        print(frame(a.source, ledger_path))
+        return 0
+    try:
+        while True:
+            body = frame(a.source, ledger_path)
+            sys.stdout.write("\x1b[2J\x1b[H" + body + "\n")
+            sys.stdout.flush()
+            time.sleep(a.interval)
+    except KeyboardInterrupt:
+        return 0
+    except OSError as e:
+        # the server going away is how a watched run ENDS, not a crash
+        print(f"\nobs_top: source gone ({e}) — run over?",
+              file=sys.stderr)
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,6 +93,91 @@ print('supervisor smoke ok: resumed_from_step', resumed[0])
 " || rc=1
 timeout -k 10 120 python scripts/obs_report.py \
   /tmp/_t1_sup/run.supervisor.jsonl --check > /dev/null || rc=1
+# Live-console smoke (obs/serve.py): a CPU run with --serve 0 must
+# expose /metrics, /status.json, and an incremental /events?after=
+# slice over stdlib urllib WHILE the run is in flight (the scraper
+# discovers the bound address from the 'serve' event in the manifest
+# log — the same discovery path a remote monitor uses), the status
+# payload must carry a schema-valid manifest, and the server must shut
+# down with the run: no leaked obs-serve thread, port closed.
+rm -f /tmp/_t1_serve.jsonl
+timeout -k 10 240 python -c "
+import json, threading, time, urllib.request
+from cpuforce import force_cpu; force_cpu()
+from mpi_cuda_process_tpu import cli
+from mpi_cuda_process_tpu.obs import trace
+path = '/tmp/_t1_serve.jsonl'
+res = {}
+def scrape():
+    url = None
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and url is None:
+        try:
+            for line in open(path):
+                rec = json.loads(line)
+                if rec.get('kind') == 'serve':
+                    url = rec['url']
+        except (OSError, ValueError):
+            pass
+        if url is None:
+            time.sleep(0.05)
+    if url is None:
+        res['err'] = 'no serve event in the telemetry log'; return
+    try:
+        m = urllib.request.urlopen(url + '/metrics', timeout=10)
+        res['metrics'] = m.read().decode()
+        s = json.load(urllib.request.urlopen(url + '/status.json',
+                                             timeout=10))
+        trace.validate_manifest(s['manifest'])  # schema-valid payload
+        for key in ('verdict', 'chunks_recent', 'heartbeat', 'restarts',
+                    'throughput'):
+            assert key in s, key
+        assert s['manifest']['tool'] == 'cli'
+        res['status'] = s
+        ev = urllib.request.urlopen(url + '/events?after=0',
+                                    timeout=10).read().decode()
+        lines = [json.loads(l) for l in ev.strip().splitlines()]
+        assert lines and lines[0]['kind'] == 'manifest', lines[:1]
+        seqs = [l['_seq'] for l in lines]
+        assert seqs == sorted(seqs) and seqs[0] == 1, seqs
+        # incremental slice via the bounded long-poll: the serve and
+        # costmodel events are already on disk, so waiting is bounded
+        # by one poller cycle
+        inc = urllib.request.urlopen(
+            url + '/events?after=%d&wait=10' % seqs[0],
+            timeout=20).read().decode()
+        inc_lines = [json.loads(l) for l in inc.strip().splitlines()]
+        assert inc_lines and inc_lines[0]['_seq'] == seqs[0] + 1, 'the '\
+            'after= slice must start exactly one past the cursor'
+        res['url'] = url
+    except Exception as e:
+        res['err'] = f'{type(e).__name__}: {e}'
+t = threading.Thread(target=scrape); t.start()
+cli.run(cli.config_from_args(
+    ['--stencil', 'life', '--grid', '512,512', '--iters', '1500',
+     '--log-every', '50', '--serve', '0',
+     '--telemetry', path]))
+t.join()
+assert 'err' not in res, res.get('err')
+assert 'obs_run_info' in res['metrics']
+leaked = [th.name for th in threading.enumerate()
+          if th.name.startswith('obs-serve')]
+assert not leaked, f'leaked server threads after run exit: {leaked}'
+try:
+    urllib.request.urlopen(res['url'] + '/status.json', timeout=3)
+    raise AssertionError('server still answering after run exit')
+except OSError:
+    pass
+print('live-console smoke ok:', res['url'])
+" || rc=1
+timeout -k 10 120 python scripts/obs_report.py /tmp/_t1_serve.jsonl \
+  --check > /dev/null || rc=1
+# The committed campaign ledger must render in both one-command
+# summary surfaces: obs_report --ledger (best_known + quarantine
+# table) and the terminal monitor's ledger mode.
+timeout -k 10 120 python scripts/obs_report.py --ledger > /dev/null || rc=1
+timeout -k 10 120 python scripts/obs_top.py benchmarks/ledger.jsonl \
+  --once > /dev/null || rc=1
 # Ledger + perf-gate smoke against a throwaway ledger: backfill the
 # historical BENCH_r0*/results_r0* files (quarantine rules exercised on
 # the real wedge rounds), ingest the smoke manifest, and run the gate in

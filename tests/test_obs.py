@@ -100,6 +100,46 @@ def test_validator_rejects_and_names_every_problem(tmp_path):
     w.close()
 
 
+def test_manifest_schema2_carries_multihost_provenance():
+    """Satellite: schema rev 2 adds process_index / process_count /
+    hostname — the multi-host prep a per-host aggregator needs."""
+    m = trace.build_manifest("cli", {"grid": [16, 16]})
+    assert m["schema"] == 2
+    prov = m["provenance"]
+    assert isinstance(prov["process_index"], int)
+    assert isinstance(prov["process_count"], int) \
+        and prov["process_count"] >= 1
+    assert isinstance(prov["hostname"], str) and prov["hostname"]
+
+    # the new fields are REQUIRED at schema 2 and type-checked
+    for mutate in (
+        lambda d: d["provenance"].pop("hostname"),
+        lambda d: d["provenance"].__setitem__("process_index", "zero"),
+        lambda d: d["provenance"].__setitem__("process_count", 0),
+    ):
+        bad = json.loads(json.dumps(m))
+        mutate(bad)
+        with pytest.raises(ValueError):
+            trace.validate_manifest(bad)
+
+
+def test_old_schema1_manifests_still_parse():
+    """Satellite: the validator accepts BOTH revisions — a pre-rev log
+    (schema 1, no host fields) must keep parsing."""
+    old = trace.build_manifest("cli", {"grid": [16, 16]})
+    old = json.loads(json.dumps(old))
+    old["schema"] = 1
+    for k in ("process_index", "process_count", "hostname"):
+        old["provenance"].pop(k)
+    trace.validate_manifest(old)  # no raise: old manifests still parse
+    # schema-1 events validate too (an old log's tail)
+    trace.validate_event({"schema": 1, "kind": "chunk", "t": time.time()})
+    # but a schema-1 writer that DID include the fields gets them typed
+    old["provenance"]["hostname"] = 42
+    with pytest.raises(ValueError, match="hostname"):
+        trace.validate_manifest(old)
+
+
 def test_validate_log_rejects_corrupt_event(tmp_path):
     path = str(tmp_path / "bad.jsonl")
     with trace.TraceWriter(path) as w:
@@ -241,6 +281,62 @@ def test_telemetry_adds_zero_ops_to_jitted_step(tmp_path):
                  "outside_call"):
         assert prim not in runner_jaxpr_after
     assert out[0].shape == fields[0].shape
+
+
+def test_serve_zero_ops_and_scrape_mid_run(tmp_path):
+    """Acceptance criterion: --serve adds zero ops to the jitted step
+    (the telemetry-invariance pin extended) and the server never blocks
+    the run loop — /metrics and /status.json answer MID-RUN, from a
+    chunk-boundary callback, while the scan is in flight."""
+    import urllib.request
+
+    from mpi_cuda_process_tpu.obs import serve as serve_lib
+
+    st = make_stencil("heat2d")
+    fields = init_state(st, (16, 128), seed=0, kind="pulse")
+    step = make_step(st, (16, 128))
+    abstract = tuple(jax.ShapeDtypeStruct(f.shape, f.dtype) for f in fields)
+    jaxpr_before = str(jax.make_jaxpr(step)(abstract))
+    runner_jaxpr_before = str(
+        jax.make_jaxpr(driver.make_runner(step, 4, jit=False))(abstract))
+
+    path = str(tmp_path / "served.jsonl")
+    session = obs.open_session(path, "cli", {"grid": [16, 128]},
+                               with_heartbeat=False)
+    server = serve_lib.serve_run(path, port=0, poll_s=0.05)
+    scraped = {}
+
+    def callback(done, fs):
+        if done != 4 or scraped:
+            return  # scrape once, mid-run (2 of 4 chunks left)
+        deadline = time.time() + 10
+        while time.time() < deadline and "metrics" not in scraped:
+            try:
+                with urllib.request.urlopen(server.url + "/metrics",
+                                            timeout=5) as r:
+                    scraped["metrics"] = r.read().decode()
+                with urllib.request.urlopen(server.url + "/status.json",
+                                            timeout=5) as r:
+                    scraped["status"] = json.loads(r.read().decode())
+            except OSError:
+                time.sleep(0.1)
+
+    try:
+        driver.run_simulation(st, fields, 8, step_fn=step, log_every=2,
+                              callback=callback, observer=session.recorder)
+        session.finish()
+    finally:
+        session.close()
+        server.close()
+
+    assert "metrics" in scraped, "mid-run scrape never succeeded"
+    assert "obs_run_info" in scraped["metrics"]
+    assert scraped["status"]["manifest"]["tool"] == "cli"
+    # the served run traced the SAME program: zero ops added
+    assert str(jax.make_jaxpr(step)(abstract)) == jaxpr_before
+    assert str(jax.make_jaxpr(
+        driver.make_runner(step, 4, jit=False))(abstract)) == \
+        runner_jaxpr_before
 
 
 def test_recorder_separates_compile_flags_recompiles_and_percentiles():
@@ -486,6 +582,42 @@ def test_obs_report_renders_attribution_and_checks(cli_log, tmp_path,
     bad = tmp_path / "bad.jsonl"
     bad.write_text('{"kind": "manifest"}\n')
     assert report.main([str(bad), "--check"]) == 1
+
+
+def test_obs_report_renders_supervisor_trail(tmp_path, capsys):
+    """Satellite: a tool="supervisor" log renders its launch/restart/
+    give-up trail (with resumed_from_step) instead of the empty and
+    misleading chunk-attribution table."""
+    report = _load_script("obs_report_sup_t", "scripts/obs_report.py")
+    path = str(tmp_path / "sup.supervisor.jsonl")
+    with trace.TraceWriter(path) as w:
+        w.write_manifest(trace.build_manifest(
+            "supervisor", {"stencil": "life", "grid": [64, 64]}))
+        w.event("launch", attempt=0, resume=False, resumed_from_step=None)
+        w.event("restart", attempt=0, reason="heartbeat verdict WEDGED",
+                detail="injected", backoff_s=0.2, checkpoint_step=30)
+        w.event("launch", attempt=1, resume=True, resumed_from_step=30)
+        w.event("summary", ok=True, attempts=2, restarts=1,
+                resumed_from_step=30)
+    assert report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "supervisor trail (2 launch(es), 1 restart(s))" in out
+    assert "heartbeat verdict WEDGED" in out
+    assert "resume" in out and "30" in out
+    assert "supervisor summary: ok=True" in out
+    # the misleading blocks are gone: no empty attribution table
+    assert "attribution (predicted vs measured)" not in out
+    assert "runtime  chunks=" not in out
+
+    # a give-up trail renders too (the other way a supervisor ends)
+    path2 = str(tmp_path / "gu.supervisor.jsonl")
+    with trace.TraceWriter(path2) as w:
+        w.write_manifest(trace.build_manifest("supervisor", {}))
+        w.event("launch", attempt=0, resume=False, resumed_from_step=None)
+        w.event("give_up", attempts=1, reason="wall-clock stall",
+                restarts=0)
+    assert report.main([path2]) == 0
+    assert "GIVE UP" in capsys.readouterr().out
 
 
 def test_obs_report_check_validates_retry_sibling(tmp_path, capsys):

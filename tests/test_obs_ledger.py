@@ -397,3 +397,41 @@ def test_bench_wedged_path_routes_quarantined_row(tmp_path, monkeypatch):
     n_before = len(rows)
     bench._stale_fallback_record()
     assert len(ledger.read_rows(lpath)) == n_before
+
+
+# ------------------------------------------------- obs_report --ledger
+
+def test_obs_report_ledger_summary_mode(tmp_path, capsys):
+    """Satellite: `obs_report.py --ledger PATH` prints the best_known
+    table per label x backend with quarantine counts + reasons — the
+    campaign state in one command."""
+    import time as _time
+
+    report = _load_script("obs_report_ledger_t", "scripts/obs_report.py")
+    lpath = str(tmp_path / "ledger.jsonl")
+    now = _time.time()
+    rows = [
+        ledger.make_row("heat3d_256_f32_fused4", 107.0, source="r03",
+                        measured_at=now, backend="tpu"),
+        ledger.make_row("heat3d_256_f32_fused4", 99.0, source="r02",
+                        measured_at=now - 10, backend="tpu"),
+        ledger.make_row("heat3d_256_f32_fused4", 0.0, source="r04",
+                        measured_at=now - 5, backend="tpu"),
+        ledger.make_row("wave3d_512", 70.0, source="r03",
+                        measured_at=now, backend="tpu",
+                        heartbeat="WEDGED"),
+    ]
+    ledger.append_rows(rows, lpath)
+    assert report.main(["--ledger", lpath]) == 0
+    out = capsys.readouterr().out
+    assert "2 quarantined" in out and "1 best-known baselines" in out
+    assert "heat3d_256_f32_fused4|tpu" in out and "107.0" in out
+    # the wedged label has NO baseline row (structurally excluded)
+    assert "wave3d_512|tpu" not in out
+    assert "quarantine reasons:" in out
+    assert "zero/missing value" in out
+    assert "heartbeat verdict WEDGED" in out
+
+    # a missing positional without --ledger is a usage error
+    with pytest.raises(SystemExit):
+        report.main([])
