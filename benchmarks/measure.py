@@ -58,6 +58,25 @@ def _parse_kspec(spec):
     return int(spec), None
 
 
+def _parse_ens(spec):
+    """Strip an ``_ensN`` token: ``"4_ens8"`` -> ("4", 8); absent -> 0.
+
+    The batched-engine label family (round 15): N members advance
+    through ONE compiled batched step and the row reports AGGREGATE
+    Mcells/s across members — the A/B against the single-sim row with
+    the same kernel class prices the per-pass fixed-cost amortization.
+    """
+    if "_ens" not in spec:
+        return spec, 0
+    head, _, tail = spec.partition("_ens")
+    num = ""
+    while tail and tail[0].isdigit():
+        num, tail = num + tail[0], tail[1:]
+    if not num:
+        raise ValueError(f"malformed _ens token in spec {spec!r}")
+    return head + tail, int(num)
+
+
 def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
             params=None):
     """compute: jnp | pallas (compute_fn inside the pad step) |
@@ -76,7 +95,11 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
     change) | streamK_shard / streamK_meshZxY
     (the STREAMING kernel sharded: z-only mesh of all devices /
     a pinned 2-axis mesh via the round-8 y-slab+corner splice — the
-    kind x mesh A/B rows) | rdmaK / rdmaK_meshZxY (the sharded
+    kind x mesh A/B rows; an ``_ensN`` token — ``streamK_ensN_shard``,
+    ``streamK_ensN_meshZxY``, also on shfused/overlap and unsharded
+    stream specs — batches N members through ONE compiled step and
+    reports AGGREGATE Mcells/s across members, the round-15 ensemble
+    A/B) | rdmaK / rdmaK_meshZxY (the sharded
     STREAMING kernel with the IN-KERNEL remote-DMA exchange,
     stepper exchange='rdma': boundary slabs ride double-buffered VMEM
     rings into the neighbor via make_async_remote_copy, zero XLA
@@ -135,6 +158,7 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
             mesh_zy = (int(mz), int(my))
         elif spec.endswith("_shard"):
             spec, shard_all = spec[:-len("_shard")], True
+        spec, ens = _parse_ens(spec)
         step_unit, tiles = _parse_kspec(spec)
         if mesh_zy or shard_all:
             if tiles is not None:
@@ -154,7 +178,7 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
             mesh = make_mesh((mesh_zy[0], mesh_zy[1], 1) if mesh_zy
                              else (n_dev, 1, 1))
             step = make_sharded_fused_step(st, mesh, grid, step_unit,
-                                           kind="stream")
+                                           kind="stream", ensemble=ens)
             if step is None:
                 raise ValueError(
                     f"untileable sharded stream k={step_unit} for {grid} "
@@ -166,15 +190,27 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
                     f"kernel (got {getattr(step, '_padfree_kind', None)!r})"
                     " — must not price a different kernel under this "
                     "label")
+            if ens and getattr(step, "_ensemble", 0) != ens:
+                raise ValueError(
+                    "ens label did not build the batched step — must "
+                    "not price a single-sim step under an ens label")
             mk = lambda: shard_fields(  # noqa: E731
-                init_state(st, grid, kind="auto"), mesh, st.ndim)
-            return _time_scan(step, mk, grid, steps, reps, step_unit)
+                init_state(st, grid, kind="auto", ensemble=ens), mesh,
+                st.ndim, ensemble=bool(ens))
+            return _time_scan(step, mk, grid, steps, reps, step_unit,
+                              members=ens)
         from mpi_cuda_process_tpu.ops.pallas.streamfused import (
             make_stream_fused_step,
         )
-        step = make_stream_fused_step(st, grid, step_unit, tiles=tiles)
+        step = make_stream_fused_step(st, grid, step_unit, tiles=tiles,
+                                      batch=ens)
         if step is None:
             raise ValueError(f"untileable stream k={step_unit} for {grid}")
+        if ens:
+            mk = lambda: init_state(st, grid, kind="auto",  # noqa: E731
+                                    ensemble=ens)
+            return _time_scan(step, mk, grid, steps, reps, step_unit,
+                              members=ens)
     elif compute.startswith("rdma"):
         # sharded STREAMING kernel with the in-kernel remote-DMA
         # exchange (stepper exchange="rdma"): same kernel class as the
@@ -302,6 +338,7 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
             spec, meshspec = spec.split("_mesh", 1)
             mz, my = meshspec.split("x", 1)
             mesh_zy = (int(mz), int(my))
+        spec, ens = _parse_ens(spec)
         step_unit, tiles = _parse_kspec(spec)
         if tiles is not None:
             raise ValueError("sharded fused labels take no tile spec")
@@ -322,7 +359,8 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
         # kernel on a different topology
         step = make_sharded_fused_step(st, mesh, grid, step_unit,
                                        overlap=ov,
-                                       padfree=True if mesh_zy else None)
+                                       padfree=True if mesh_zy else None,
+                                       ensemble=ens)
         if mesh_zy and step is not None and \
                 not str(getattr(step, "_padfree_kind", "")).startswith(
                     "yzslab"):
@@ -340,8 +378,10 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
                 "(local z < 3m) — must not price the plain step under an "
                 "overlap label")
         mk = lambda: shard_fields(  # noqa: E731
-            init_state(st, grid, kind="auto"), mesh, st.ndim)
-        return _time_scan(step, mk, grid, steps, reps, step_unit)
+            init_state(st, grid, kind="auto", ensemble=ens), mesh,
+            st.ndim, ensemble=bool(ens))
+        return _time_scan(step, mk, grid, steps, reps, step_unit,
+                          members=ens)
     elif compute.startswith("fused"):
         from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
         step_unit, tiles = _parse_kspec(compute[len("fused"):])
@@ -370,7 +410,7 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
     return _time_scan(step, mk, grid, steps, reps, step_unit)
 
 
-def _time_scan(step, mk, grid, steps, reps, step_unit):
+def _time_scan(step, mk, grid, steps, reps, step_unit, members=0):
     run_a = make_runner(step, steps)
     run_b = make_runner(step, 4 * steps)
     _fence(run_a(mk()))  # compile + warm
@@ -397,9 +437,14 @@ def _time_scan(step, mk, grid, steps, reps, step_unit):
                          f"t_b={t_b:.4f}s (timing noise; rerun)",
                 "suspect": True}
     per_step = (t_b - t_a) / (3 * steps * step_unit)
-    mcells = math.prod(grid) / per_step / 1e6
-    return {"ms_per_step": round(per_step * 1e3, 4),
-            "mcells_per_s": round(mcells, 1)}
+    # aggregate cells: a batched row advances every member each step
+    mcells = max(1, members) * math.prod(grid) / per_step / 1e6
+    rec = {"ms_per_step": round(per_step * 1e3, 4),
+           "mcells_per_s": round(mcells, 1)}
+    if members:
+        rec["ensemble"] = members
+        rec["mcells_per_s_per_member"] = round(mcells / members, 1)
+    return rec
 
 
 # (label, stencil, grid, steps, dtype, compute)
@@ -695,6 +740,23 @@ CONFIGS = [
      "float32", "rdma4_mesh8x8"),
     ("wave3d_512_f32_rdma4_mesh8x8", "wave3d", (512, 512, 512), 8,
      "float32", "rdma4_mesh8x8"),
+    # ── Tier D12: batched ensemble engine (round 15) — *_ens8 rows:
+    # 8 members advance through ONE compiled batched streaming step
+    # (vmap folds the member axis into each exchange operand; one batch
+    # grid dimension per kernel); the row reports AGGREGATE Mcells/s.
+    # A/B against the single-sim stream4_shard/_mesh8x8 rows — same
+    # kernel class, only the batching changes — prices the per-pass
+    # fixed-cost amortization the ensemble engine claims.  The ledger
+    # keys these rows by ensemble size (obs/ledger.baseline_key), so
+    # an ens=8 aggregate can never baseline a single-sim row.
+    ("heat3d_512_f32_stream4_ens8_shard", "heat3d", (512, 512, 512), 10,
+     "float32", "stream4_ens8_shard"),
+    ("wave3d_512_f32_stream4_ens8_shard", "wave3d", (512, 512, 512), 8,
+     "float32", "stream4_ens8_shard"),
+    ("heat3d_512_f32_stream4_ens8_mesh8x8", "heat3d", (512, 512, 512),
+     10, "float32", "stream4_ens8_mesh8x8"),
+    ("wave3d_512_f32_stream4_ens8_mesh8x8", "wave3d", (512, 512, 512),
+     8, "float32", "stream4_ens8_mesh8x8"),
 ]
 
 # Tier-D labels: new large Mosaic compiles.  A hang here is plausibly a
@@ -723,7 +785,7 @@ _RISKY = frozenset(
 # rev 9: the in-kernel remote-DMA exchange (exchange='rdma') — new
 # rdmaK labels exist, and the streaming steppers grew the transport
 # layer (halo.RdmaTransport threading), so older declines retry.
-BUILDER_REV = 9
+BUILDER_REV = 10
 
 
 def _skip_cached(cached):

@@ -78,13 +78,13 @@ def _time_run(run, fields, reps: int) -> float:
 
 def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
                  fuse=0, fuse_kind=None, pipeline=False,
-                 exchange="ppermute"):
+                 exchange="ppermute", ensemble=0):
     import jax
 
     from mpi_cuda_process_tpu import (
         init_state, make_mesh, make_sharded_step, make_step, shard_fields,
     )
-    from mpi_cuda_process_tpu.driver import make_runner
+    from mpi_cuda_process_tpu.driver import make_ensemble_step, make_runner
 
     n_dev = math.prod(mesh_shape)
     step_unit = 1
@@ -110,7 +110,8 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
                                               kind=fuse_kind,
                                               overlap=overlap,
                                               pipeline=pipeline,
-                                              exchange=exchange)
+                                              exchange=exchange,
+                                              ensemble=ensemble)
             if step is None:
                 return None
             if exchange == "rdma" and \
@@ -140,7 +141,8 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
             kernel_kind = getattr(step, "_padfree_kind", None)
             step_unit = fuse
         else:
-            step = make_sharded_step(st, mesh, global_shape, overlap=overlap)
+            step = make_sharded_step(st, mesh, global_shape, overlap=overlap,
+                                     ensemble=ensemble)
     elif fuse > 1:
         if st.ndim == 2:
             from mpi_cuda_process_tpu.ops.pallas.fullgrid import (
@@ -153,7 +155,8 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
                 make_stream_fused_step,
             )
 
-            step = make_stream_fused_step(st, global_shape, fuse)
+            step = make_stream_fused_step(st, global_shape, fuse,
+                                          batch=ensemble)
         else:
             from mpi_cuda_process_tpu.ops.pallas.fused import make_fused_step
 
@@ -164,9 +167,15 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
         step_unit = fuse
     else:
         step = make_step(st, global_shape)
-    fields = init_state(st, global_shape, kind="auto")
+    if ensemble and n_dev == 1 and \
+            getattr(step, "_ensemble", 0) != ensemble:
+        # 1-device rungs: batch the plain/tiled step by vmap (the
+        # streaming builder and the sharded steppers arrive batched)
+        step = make_ensemble_step(step)
+    fields = init_state(st, global_shape, kind="auto", ensemble=ensemble)
     if n_dev > 1:
-        fields = shard_fields(fields, mesh, st.ndim)
+        fields = shard_fields(fields, mesh, st.ndim,
+                              ensemble=bool(ensemble))
     # No donation: the same input fields are reused across timing reps.
     run_nodonate = make_runner(step, steps, jit=False)
     run = jax.jit(run_nodonate)
@@ -174,7 +183,8 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
 
     float(jnp.sum(run(fields)[0]))  # compile + warm
     t = _time_run(run, fields, reps)
-    cells = math.prod(global_shape)
+    # aggregate cells: a batched rung advances every member each step
+    cells = max(1, ensemble) * math.prod(global_shape)
     return (cells * steps * step_unit / t / 1e6, t / (steps * step_unit),
             kernel_kind)
 
@@ -298,6 +308,18 @@ def main(argv=None) -> int:
                         "width-k exchange (weak/strong modes; meshes keep "
                         "the lane axis whole — untileable rungs are "
                         "skipped)")
+    p.add_argument("--ensemble", type=int, default=0, metavar="N",
+                   help="batched-engine ladder arm (round 15): every "
+                        "rung advances N members through ONE compiled "
+                        "batched step (vmapped local update; one "
+                        "exchange round per site regardless of N) and "
+                        "reports AGGREGATE Mcells/s across members — "
+                        "the A/B against the same ladder without "
+                        "--ensemble prices the per-pass fixed-cost "
+                        "amortization.  Every emitted row stamps the "
+                        "ensemble size, so batched rows are never "
+                        "confused with single-sim rows (the ledger "
+                        "keys them apart)")
     p.add_argument("--telemetry", default=None, metavar="PATH",
                    help="write a JSONL telemetry event log (obs/ "
                         "schema, same manifest as cli --telemetry): "
@@ -436,7 +458,8 @@ def _ladder(a, p, jax, st, n_devices, _tel) -> int:
         got = bench_config(
             st, mesh_shape, global_shape, a.steps, a.reps,
             overlap=a.overlap, fuse=a.fuse, fuse_kind=a.fuse_kind,
-            pipeline=a.pipeline, exchange=a.exchange)
+            pipeline=a.pipeline, exchange=a.exchange,
+            ensemble=a.ensemble)
         if got is None:
             print(f"[scaling] skip {mesh_shape}: untileable fused "
                   f"k={a.fuse}"
@@ -462,6 +485,7 @@ def _ladder(a, p, jax, st, n_devices, _tel) -> int:
             "pipeline": a.pipeline,
             "fuse_kind": a.fuse_kind,
             "exchange": a.exchange,
+            "ensemble": a.ensemble,
             "kernel_kind": kernel_kind,
             "mesh_axes": a.mesh_axes,
             "mesh": list(mesh_shape), "grid": list(global_shape),

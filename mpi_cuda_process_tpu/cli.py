@@ -136,8 +136,36 @@ def build_parser() -> argparse.ArgumentParser:
                         "non-blocking via the native writer pool)")
     p.add_argument("--dump-dir", default=None)
     p.add_argument("--ensemble", type=int, default=0,
-                   help="run N independent universes batched via vmap "
-                        "(seeds seed..seed+N-1)")
+                   help="run N independent universes batched through ONE "
+                        "compiled step (seeds seed..seed+N-1; a leading "
+                        "member axis rides init -> stepper -> "
+                        "diagnostics).  Composes with --mesh: the "
+                        "batched sharded steppers vmap the local update "
+                        "per member, so the halo exchange stays ONE "
+                        "round per site regardless of N and every Pallas "
+                        "kernel gains one batch grid dimension — the "
+                        "per-step fixed costs (exchange rounds, kernel "
+                        "launches, compile, telemetry cadence) are paid "
+                        "once per BATCH.  Composes with --fuse (every "
+                        "kind incl. stream), --overlap, --pipeline, and "
+                        "--exchange rdma")
+    p.add_argument("--ensemble-mesh", type=int, default=0, metavar="M",
+                   help="shard the member axis over M device groups — "
+                        "the ensemble becomes a THIRD mesh axis "
+                        "(ensemble x y x z, e.g. a v5e-64 as 8x8 "
+                        "spatial x M-way ensemble; each group is an "
+                        "independent spatial mesh, so halo ppermutes "
+                        "never cross members).  Needs --ensemble N with "
+                        "N %% M == 0 and M x prod(--mesh) devices; "
+                        "0/1 = every device holds all N members")
+    p.add_argument("--ensemble-perturb", type=float, default=0.0,
+                   metavar="EPS",
+                   help="per-member init perturbation: member i's "
+                        "inexact fields scaled by 1 + EPS * u_i with "
+                        "u_i ~ U(-1,1) drawn from (seed, i) — "
+                        "deterministic parameter diversity for ensemble "
+                        "studies beyond the per-member seeds (guard "
+                        "frames re-pinned; integer fields untouched)")
     p.add_argument("--compute", default="auto",
                    choices=["auto", "jnp", "pallas"],
                    help="execution strategy (auto: the measured-fastest "
@@ -268,7 +296,8 @@ def config_from_args(argv=None) -> RunConfig:
         resume=a.resume, render=a.render, profile_dir=a.profile_dir,
         profile=a.profile, telemetry=a.telemetry,
         compute=a.compute, overlap=a.overlap, pipeline=a.pipeline,
-        ensemble=a.ensemble,
+        ensemble=a.ensemble, ensemble_mesh=a.ensemble_mesh,
+        ensemble_perturb=a.ensemble_perturb,
         fuse=a.fuse, fuse_kind=a.fuse_kind, exchange=a.exchange,
         tol=a.tol, tol_check_every=a.tol_check_every,
         check_finite=a.check_finite, debug_checks=a.debug_checks,
@@ -325,8 +354,15 @@ _AUTO_FUSE_KIND: dict = {}
 
 
 def _uses_mesh(cfg: RunConfig) -> bool:
-    """Whether this run decomposes over a device mesh (sharded step_fn)."""
-    return bool(cfg.mesh) and math.prod(cfg.mesh) > 1 and not cfg.ensemble
+    """Whether this run decomposes over a device mesh (sharded step_fn).
+
+    True for a spatial decomposition (--mesh) AND for a pure
+    data-parallel ensemble (--ensemble-mesh with no spatial axes): both
+    run the shard_map steppers; --ensemble alone (one device, N members
+    batched) stays on the vmapped single-device path.
+    """
+    return (bool(cfg.mesh) and math.prod(cfg.mesh) > 1) \
+        or cfg.ensemble_mesh > 1
 
 
 def _make_cfg_stencil(cfg: RunConfig):
@@ -465,6 +501,23 @@ def _abstract_fields(st, cfg: RunConfig, sharding):
                  for _ in range(st.num_fields))
 
 
+def _validate_ensemble(cfg: RunConfig) -> None:
+    """Fail-fast checks for the batched-run flags (before any build)."""
+    if cfg.ensemble_mesh > 1:
+        if not cfg.ensemble:
+            raise ValueError(
+                "--ensemble-mesh shards the member axis of a batched "
+                "run; it needs --ensemble N")
+        if cfg.ensemble % cfg.ensemble_mesh:
+            raise ValueError(
+                f"--ensemble {cfg.ensemble} not divisible by "
+                f"--ensemble-mesh {cfg.ensemble_mesh}")
+    if cfg.ensemble_perturb and not cfg.ensemble:
+        raise ValueError(
+            "--ensemble-perturb perturbs ensemble members; it needs "
+            "--ensemble N")
+
+
 def _resume(cfg: RunConfig, targets):
     """Load the latest checkpoint (format auto-detected) onto ``targets``.
 
@@ -491,8 +544,10 @@ def build(cfg: RunConfig):
     st = _make_cfg_stencil(cfg)
 
     start_step = 0
+    _validate_ensemble(cfg)
     use_mesh = _uses_mesh(cfg)
-    m = mesh_lib.make_mesh(cfg.mesh) if use_mesh else None
+    m = mesh_lib.make_mesh(cfg.mesh, ensemble=cfg.ensemble_mesh or 1) \
+        if use_mesh else None
     resuming = (cfg.resume and cfg.checkpoint_dir
                 and checkpointing.checkpoint_format(cfg.checkpoint_dir))
     if resuming:
@@ -504,24 +559,26 @@ def build(cfg: RunConfig):
         from jax.sharding import NamedSharding, SingleDeviceSharding
 
         if m is not None:
-            sharding = NamedSharding(
-                m, stepper_lib.grid_partition_spec(st.ndim, m))
+            spec = stepper_lib.ensemble_partition_spec(st.ndim, m) \
+                if cfg.ensemble else \
+                stepper_lib.grid_partition_spec(st.ndim, m)
+            sharding = NamedSharding(m, spec)
         else:
             sharding = SingleDeviceSharding(jax.devices()[0])
         fields = _abstract_fields(st, cfg, sharding)
     elif m is not None:
-        # Shard-native init: each device computes its own block; no process
-        # materializes the full grid (utils/init.py::init_state_sharded).
+        # Shard-native init: each device computes its own block(s); no
+        # process materializes the full grid (init_state_sharded) — the
+        # member axis lands directly on the ensemble mesh axis when one
+        # exists.
         fields = init_state_sharded(
             st, cfg.grid, m, cfg.seed, cfg.density, cfg.init,
-            periodic=cfg.periodic)
+            periodic=cfg.periodic, ensemble=cfg.ensemble,
+            perturb=cfg.ensemble_perturb)
     else:
         fields = init_state(st, cfg.grid, cfg.seed, cfg.density, cfg.init,
-                            periodic=cfg.periodic, ensemble=cfg.ensemble)
-
-    if cfg.ensemble and cfg.mesh and math.prod(cfg.mesh) > 1:
-        raise ValueError("--ensemble currently excludes --mesh; "
-                         "use one batching strategy at a time")
+                            periodic=cfg.periodic, ensemble=cfg.ensemble,
+                            perturb=cfg.ensemble_perturb)
     if cfg.fuse_kind != "auto" and not cfg.fuse:
         # a forced kind with auto-selected fuse would route maybe_auto_fuse
         # upgrades into a kernel that was never probed (and silently no-op
@@ -590,7 +647,7 @@ def build(cfg: RunConfig):
             fused = stepper_lib.make_sharded_temporal_step(
                 st, m, cfg.grid, cfg.fuse, periodic=cfg.periodic,
                 kind=kind, overlap=cfg.overlap, pipeline=cfg.pipeline,
-                exchange=cfg.exchange)
+                exchange=cfg.exchange, ensemble=cfg.ensemble)
             if cfg.overlap and fused is not None and \
                     not getattr(fused, "_overlap_active", False):
                 log.warning(
@@ -631,12 +688,16 @@ def build(cfg: RunConfig):
         elif cfg.fuse_kind == "stream":
             from .ops.pallas.streamfused import make_stream_fused_step
 
-            if cfg.periodic or cfg.ensemble:
+            if cfg.periodic:
                 raise ValueError(
-                    "--fuse-kind stream is guard-frame, unbatched only "
-                    "(the manual-DMA kernel has no periodic wrap path and "
-                    "does not vmap)")
-            fused = make_stream_fused_step(st, cfg.grid, cfg.fuse)
+                    "--fuse-kind stream is guard-frame only (the "
+                    "manual-DMA kernel has no periodic wrap path)")
+            # --ensemble N batches the streaming kernel with an EXPLICIT
+            # leading batch grid dimension (round 15 — the old
+            # 'unbatched only' wall is gone); the returned step is
+            # already batched, so the vmap wrap below is skipped
+            fused = make_stream_fused_step(st, cfg.grid, cfg.fuse,
+                                           batch=cfg.ensemble)
             if fused is None:
                 raise ValueError(
                     f"--fuse {cfg.fuse} --fuse-kind stream unsupported for "
@@ -664,12 +725,16 @@ def build(cfg: RunConfig):
                     f"{cfg.grid} (need a fused kernel, 2*k*halo a multiple "
                     f"of the dtype's sublane tile — 8 for f32, 16 for bf16 "
                     f"— and an aligned tiling)")
-        if cfg.ensemble:
+        if cfg.ensemble and getattr(fused, "_ensemble", 0) != \
+                cfg.ensemble:
             # N independent universes, each advancing k steps per kernel
             # pass: vmap adds a leading batch grid dimension to the
             # pallas_call (per-universe equivalence for both the 2D
             # whole-grid and 3D windowed kernels —
-            # tests/test_cli.py::test_ensemble_composes_with_fuse{,_3d})
+            # tests/test_cli.py::test_ensemble_composes_with_fuse{,_3d}).
+            # The sharded and streaming builders return ALREADY-batched
+            # steps (they tag _ensemble); only the unsharded tiled /
+            # 2D kinds take the plain vmap wrap here.
             fused = driver.make_ensemble_step(fused)
         if resuming:
             fields, start_step = _resume(cfg, fields)
@@ -677,7 +742,7 @@ def build(cfg: RunConfig):
         return st, fused, fields, start_step
     raw_step = resolve_raw_step(cfg, st)
     compute_fn = None if raw_step is not None else resolve_compute_fn(cfg, st)
-    if cfg.ensemble:
+    if cfg.ensemble and not use_mesh:
         step_fn = driver.make_ensemble_step(driver.make_step(
             st, cfg.grid, periodic=cfg.periodic, compute_fn=compute_fn))
         if resuming:
@@ -686,7 +751,7 @@ def build(cfg: RunConfig):
     if use_mesh:
         step_fn = stepper_lib.make_sharded_step(
             st, m, cfg.grid, periodic=cfg.periodic, compute_fn=compute_fn,
-            overlap=cfg.overlap)
+            overlap=cfg.overlap, ensemble=cfg.ensemble)
     elif raw_step is not None:
         log.info("compute: whole-step raw Pallas kernel (%s)", st.name)
         step_fn = raw_step
@@ -818,7 +883,7 @@ def _check_mem_budget(cfg: RunConfig) -> None:
             ensemble=cfg.ensemble, periodic=cfg.periodic,
             compute=compute, fuse_kind=cfg.fuse_kind,
             overlap=cfg.overlap, pipeline=cfg.pipeline,
-            exchange=cfg.exchange)
+            exchange=cfg.exchange, ensemble_mesh=cfg.ensemble_mesh)
     except ValueError:
         if cfg.mem_check == "error":
             raise
@@ -847,7 +912,8 @@ def _open_telemetry(cfg: RunConfig):
         stall_after_s = 600.0
     return obs.open_session(
         cfg.telemetry, tool="cli", run=dataclasses.asdict(cfg),
-        step_unit=max(1, cfg.fuse), stall_after_s=stall_after_s)
+        step_unit=max(1, cfg.fuse), stall_after_s=stall_after_s,
+        ensemble=cfg.ensemble)
 
 
 def _emit_static_cost(cfg: RunConfig, st, session) -> None:
@@ -858,7 +924,8 @@ def _emit_static_cost(cfg: RunConfig, st, session) -> None:
         session.event("costmodel", **costmodel.static_cost(
             st, cfg.grid, mesh=cfg.mesh, fuse=cfg.fuse,
             fuse_kind=cfg.fuse_kind, periodic=cfg.periodic,
-            ensemble=cfg.ensemble, exchange=cfg.exchange))
+            ensemble=cfg.ensemble, exchange=cfg.exchange,
+            ensemble_mesh=cfg.ensemble_mesh))
     except Exception:  # noqa: BLE001 — telemetry is never load-bearing
         log.debug("static cost model failed; trace goes without it",
                   exc_info=True)

@@ -43,7 +43,18 @@ class RunConfig:
     # exchange issued from pass i's shell outputs, one interior pass ahead
     # of its consumer; needs --fuse + --mesh + a slab-operand kind
     pipeline: bool = False
-    ensemble: int = 0  # >0: batch of independent universes via vmap
+    ensemble: int = 0  # >0: batch of N independent universes (leading
+    # member axis through init -> stepper -> diagnostics; composes with
+    # --mesh — the batched sharded steppers compile ONCE for all N)
+    # ensemble-axis device shards (round 15): the member axis becomes a
+    # THIRD mesh dimension of that many shards (ensemble x y x z, e.g.
+    # v5e-64 as 8x8 spatial x N-way ensemble); 0/1 = every device holds
+    # all N members' local blocks.  Needs --ensemble, N % M == 0.
+    ensemble_mesh: int = 0
+    # per-member init perturbation: member i's inexact fields scaled by
+    # 1 + eps * u_i, u_i ~ U(-1,1) from (seed, i) — deterministic
+    # parameter diversity beyond the per-member seeds (utils/init.py)
+    ensemble_perturb: float = 0.0
     fuse: int = 0  # >0: temporal blocking, k steps per HBM pass (experimental)
     # which fused kernel carries --fuse (3D unsharded only; auto = measured
     # default): tiled (padded 4-block) | padfree (9-block raw-grid) |
@@ -101,6 +112,45 @@ class RunConfig:
 # re-served would race the parent for the port.
 _ARGV_SKIP = frozenset({"supervise", "max_restarts", "restart_backoff",
                         "supervise_stall_s", "serve_port"})
+
+
+# --------------------------------------------------------------------------
+# Simulation-state vs request-lifecycle split (round 15, the ensemble
+# engine's submit/handle API).  SIMULATION fields determine WHAT is
+# computed — the compiled program and its numerics: two configs equal on
+# these produce bit-identical trajectories.  LIFECYCLE fields determine
+# how a request is watched, persisted, instrumented, and served — they
+# may differ between two submissions of the same simulation without
+# changing a single computed value (telemetry is zero-ops-in-the-step by
+# the obs/ invariant; checkpoint/resume is bit-exact by the checkpoint
+# contract; debug instrumentation only adds checks).  The two sets
+# PARTITION RunConfig — a new field must be classified here or
+# tests/test_ensemble_engine.py fails, so the split cannot rot silently.
+
+LIFECYCLE_FIELDS = frozenset({
+    "log_every", "checkpoint_every", "checkpoint_dir",
+    "checkpoint_backend", "resume", "render", "profile_dir", "profile",
+    "check_finite", "debug_checks", "dump_every", "dump_dir",
+    "telemetry", "mem_check", "supervise", "max_restarts",
+    "restart_backoff", "supervise_stall_s", "serve_port",
+})
+
+SIM_FIELDS = frozenset(
+    f.name for f in dataclasses.fields(RunConfig)
+) - LIFECYCLE_FIELDS
+
+
+def sim_config_dict(cfg: RunConfig) -> Dict[str, Any]:
+    """The simulation-state fields of ``cfg`` alone, as a plain dict."""
+    return {k: v for k, v in dataclasses.asdict(cfg).items()
+            if k in SIM_FIELDS}
+
+
+def sim_signature(cfg: RunConfig) -> str:
+    """Canonical JSON of the simulation state — the engine's identity
+    key: two requests with equal signatures compute the same
+    trajectory (and can share a compile cache entry)."""
+    return json.dumps(sim_config_dict(cfg), sort_keys=True)
 
 
 def to_argv(cfg: RunConfig) -> list:

@@ -1,0 +1,242 @@
+"""Request-lifecycle layer: an async submit/handle API over the core.
+
+The split this module completes (round 15, ROADMAP item 2): the
+simulation core — ``cli.build`` constructing a stepper from SIMULATION
+fields, ``driver.run_simulation`` advancing state — knows nothing about
+requests; this module owns everything request-shaped: identity, queuing,
+background execution, telemetry wiring, and live status.  The boundary
+is formalized in ``config.SIM_FIELDS`` / ``config.LIFECYCLE_FIELDS``
+(the two sets partition ``RunConfig``; a new field must pick a side or
+the partition test fails).
+
+Usage — submit a config, get a handle, stream chunk telemetry::
+
+    eng = SimulationEngine()
+    h = eng.submit(RunConfig(stencil="heat3d", grid=(64, 64, 128),
+                             iters=100, ensemble=8, log_every=10))
+    h.status()            # live: manifest, latest chunk, per-member
+                          # throughput, heartbeat verdict — the same
+                          # payload /status.json serves
+    for ev in h.events(after=0): ...   # raw obs records, seq-ordered
+    fields, mcells = h.result()        # blocks; re-raises run errors
+
+Every handle runs the ONE ordinary CLI path (``cli.run``) in a daemon
+thread with telemetry forced on (a derived path when the request did
+not name one — the same discipline as the supervisor's forced
+telemetry), so the chunk stream a handle exposes is the exact obs/
+vocabulary every other tool reads, and a handle's run can be watched
+remotely by pointing ``obs/serve.py`` (or ``--serve``) at its log.
+Batched requests (``ensemble=N``) stream per-member throughput: the
+chunk records carry the member count, and :meth:`RunHandle.status`
+reports aggregate AND per-member Gcells/s (``obs/metrics.RunMetrics``).
+
+Thread-safety: jax tracing/execution is serialized per engine by a run
+lock — submissions queue FIFO behind it (one device set, one compiled
+step at a time); ``submit`` itself never blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .config import RunConfig, SIM_FIELDS, sim_signature
+
+__all__ = ["RunHandle", "SimulationEngine"]
+
+
+class RunHandle:
+    """One submitted simulation request: identity + lifecycle + results.
+
+    The request-lifecycle face of a run — everything here reads the
+    telemetry log or the thread state; nothing touches the simulation
+    core (the same zero-ops discipline as the rest of obs/).
+    """
+
+    def __init__(self, run_id: str, config: RunConfig,
+                 telemetry_path: str):
+        self.id = run_id
+        self.config = config
+        self.sim_signature = sim_signature(config)
+        self.telemetry_path = telemetry_path
+        self.submitted_at = time.time()
+        self._done = threading.Event()
+        self._result: Optional[Tuple] = None
+        self._error: Optional[BaseException] = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> Tuple:
+        """Block for ``(final_fields, mcells_per_s)``; re-raises the
+        run's exception (the submit/handle analogue of a CLI exit)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"run {self.id} still executing after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    # -- telemetry ------------------------------------------------------
+
+    def events(self, after: int = 0,
+               limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Raw obs records past sequence number ``after`` (1-based,
+        ``_seq``-annotated — the same cursor contract as the live
+        console's ``/events?after=``).  Complete lines only: a record
+        mid-write is picked up by the next call, never truncated."""
+        out: List[Dict[str, Any]] = []
+        try:
+            with open(self.telemetry_path) as fh:
+                for seq, line in enumerate(fh, start=1):
+                    if seq <= after or not line.endswith("\n"):
+                        continue
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    rec["_seq"] = seq
+                    out.append(rec)
+                    if limit is not None and len(out) >= limit:
+                        break
+        except OSError:
+            pass
+        return out
+
+    def status(self) -> Dict[str, Any]:
+        """The live status payload — identical vocabulary to
+        ``/status.json`` (``obs/metrics.RunMetrics.status``), plus the
+        handle's request identity and phase."""
+        from .obs.metrics import RunMetrics
+
+        rm = RunMetrics()
+        for rec in self.events():
+            rec = dict(rec)
+            rec.pop("_seq", None)
+            rm.ingest(rec)
+        out = rm.status()
+        out["request"] = {
+            "id": self.id,
+            "submitted_at": self.submitted_at,
+            "telemetry": self.telemetry_path,
+            "sim_signature": self.sim_signature,
+            "phase": ("failed" if self._error is not None else
+                      "done" if self._done.is_set() else "running"),
+        }
+        return out
+
+
+class SimulationEngine:
+    """Async request front-end: ``submit(cfg) -> RunHandle``.
+
+    One engine serializes execution over the process's device set (the
+    run lock); handles queue FIFO.  The engine neither copies nor
+    re-validates simulation semantics — ``cli.run`` stays the single
+    execution path, so submit/handle runs behave byte-for-byte like the
+    equivalent command line (auto-fuse, budget guard, pallas retry,
+    epilogue included).
+    """
+
+    _ids = itertools.count()
+
+    def __init__(self, telemetry_dir: Optional[str] = None):
+        from .obs import trace as trace_lib
+
+        self.telemetry_dir = telemetry_dir or \
+            trace_lib.default_telemetry_dir()
+        self._run_lock = threading.Lock()
+        self._handles: List[RunHandle] = []
+
+    # -- submission -----------------------------------------------------
+
+    def _prepare(self, cfg: RunConfig) -> RunConfig:
+        """Lifecycle-field normalization: telemetry forced on (derived
+        path when unset) so every handle has a chunk stream; a logging
+        cadence derived for batched runs that set none (no chunk
+        boundaries -> no stream to hand back).  SIMULATION fields are
+        never touched — asserted, not assumed."""
+        before = {k: v for k, v in dataclasses.asdict(cfg).items()
+                  if k in SIM_FIELDS}
+        if not cfg.telemetry:
+            os.makedirs(self.telemetry_dir, exist_ok=True)
+            cfg = dataclasses.replace(cfg, telemetry=os.path.join(
+                self.telemetry_dir,
+                f"engine-{os.getpid()}-{int(time.time() * 1e3)}-"
+                f"{next(self._ids)}.jsonl"))
+        if not cfg.log_every and not cfg.tol:
+            step_unit = max(1, cfg.fuse)
+            chunk = max(step_unit, (cfg.iters // 8) // step_unit
+                        * step_unit)
+            cfg = dataclasses.replace(cfg, log_every=chunk)
+        after = {k: v for k, v in dataclasses.asdict(cfg).items()
+                 if k in SIM_FIELDS}
+        assert after == before, "engine touched a simulation field"
+        return cfg
+
+    def submit(self, cfg: RunConfig) -> RunHandle:
+        """Queue a request; returns immediately with its handle.
+
+        Launcher-mode lifecycle fields are rejected here — a supervised
+        or served run owns its own process lifecycle, which is exactly
+        what the engine is (use ``--supervise``/``--serve`` via the CLI
+        for those modes).
+        """
+        if cfg.supervise:
+            raise ValueError(
+                "engine.submit runs in-process; --supervise forks its "
+                "own supervision tree — launch supervised runs through "
+                "the CLI")
+        cfg = self._prepare(cfg)
+        handle = RunHandle(f"run-{os.getpid()}-{next(self._ids)}", cfg,
+                           cfg.telemetry)
+        self._handles.append(handle)
+        t = threading.Thread(target=self._execute, args=(handle,),
+                             name=f"sim-engine-{handle.id}", daemon=True)
+        handle._thread = t
+        t.start()
+        return handle
+
+    def _execute(self, handle: RunHandle) -> None:
+        from . import cli
+
+        with self._run_lock:
+            try:
+                handle._result = cli.run(handle.config)
+            except BaseException as e:  # noqa: BLE001 — delivered via
+                handle._error = e       # handle.result(), never lost
+            finally:
+                handle._done.set()
+
+    # -- introspection --------------------------------------------------
+
+    def handles(self) -> List[RunHandle]:
+        return list(self._handles)
+
+    def status(self) -> Dict[str, Any]:
+        """Engine-level summary: one row per handle (id, phase, sim
+        signature, telemetry path) — the campaign-console shape."""
+        rows = []
+        for h in self._handles:
+            rows.append({
+                "id": h.id,
+                "phase": ("failed" if h._error is not None else
+                          "done" if h.done() else "running"),
+                "ensemble": h.config.ensemble or None,
+                "telemetry": h.telemetry_path,
+                "submitted_at": h.submitted_at,
+            })
+        return {"handles": rows, "pending": sum(
+            1 for h in self._handles if not h.done())}

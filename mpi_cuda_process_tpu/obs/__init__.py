@@ -115,6 +115,7 @@ def open_session(
     step_unit: int = 1,
     stall_after_s: float = 600.0,
     with_heartbeat: bool = True,
+    ensemble: int = 0,
     **manifest_extra: Any,
 ) -> Session:
     """Open a trace at ``path``, write the manifest, start the heartbeat.
@@ -125,7 +126,8 @@ def open_session(
     trace = trace_lib.TraceWriter(path)
     trace.write_manifest(trace_lib.build_manifest(
         tool, run, **manifest_extra))
-    recorder = runtime_lib.RuntimeRecorder(trace=trace, step_unit=step_unit)
+    recorder = runtime_lib.RuntimeRecorder(trace=trace, step_unit=step_unit,
+                                           ensemble=ensemble)
     hb = None
     if with_heartbeat:
         hb = heartbeat_lib.Heartbeat(recorder, trace=trace,

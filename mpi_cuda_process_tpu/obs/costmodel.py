@@ -166,6 +166,7 @@ def comm_stats(
     fuse_kind: str = "auto",
     periodic: bool = False,
     exchange: str = "ppermute",
+    batch: int = 1,
 ) -> Optional[Dict[str, Any]]:
     """Analytic ppermute rounds + bytes per device, or None (unsharded).
 
@@ -185,6 +186,12 @@ def comm_stats(
       already padded; the plain step exchanges only fields with a
       nonzero ``field_halo`` at width ``halo``, the fused kinds every
       field at width ``m``.
+
+    ``batch=N`` (the ensemble engine): the ROUND COUNT is unchanged —
+    vmap folds the member axis into each collective operand, the
+    structural pin of the batched steppers — while every per-device
+    byte quantity (ICI payloads, slab operand storage) scales by the N
+    members the device holds.
 
     ``exchange="rdma"`` (streaming kind): the same slab set crosses the
     ICI, but as in-kernel remote-DMA chunks instead of ppermutes — the
@@ -208,6 +215,7 @@ def comm_stats(
     local = _local_shape(grid, mesh)
     item = jnp.dtype(stencil.dtype).itemsize
     nf = stencil.num_fields
+    batch = max(1, int(batch))
 
     if fuse:
         from ..ops.pallas.fused import _halo_per_micro
@@ -285,10 +293,14 @@ def comm_stats(
         "per_pass_steps": per_pass_steps,
         "width_m": max(widths),
         "sharded_counts": list(counts),
+        "members_per_device": batch,
+        # round count is BATCH-INDEPENDENT (the vmap collective-batching
+        # pin); bytes scale with the members each device holds
         "ppermute_rounds_per_pass": 0 if rdma else rounds,
-        "ici_bytes_per_pass": ici,
-        "ici_bytes_per_step": ici / per_pass_steps,
-        "slab_operand_bytes": None if rdma else operand,
+        "ici_bytes_per_pass": batch * ici,
+        "ici_bytes_per_step": batch * ici / per_pass_steps,
+        "slab_operand_bytes": None if rdma else (
+            None if operand is None else batch * operand),
     }
     if rdma:
         # one ring-kernel invocation per site PER FIELD; the DMA count
@@ -356,6 +368,8 @@ def budget_crosscheck(
     fuse: int,
     fuse_kind: str,
     periodic: bool = False,
+    ensemble: int = 0,
+    ensemble_mesh: int = 0,
 ) -> Optional[Dict[str, Any]]:
     """Assert-by-record: this module's slab-operand bytes vs budget.py's.
 
@@ -365,15 +379,18 @@ def budget_crosscheck(
     is visible in every event log, and tests pin ``match == True`` for
     config 5 on both mesh families.
     """
+    members = (max(1, int(ensemble)) // max(1, int(ensemble_mesh))
+               if ensemble else 1)
     cs = comm_stats(stencil, grid, mesh, fuse=fuse, fuse_kind=fuse_kind,
-                    periodic=periodic)
+                    periodic=periodic, batch=members)
     if cs is None or cs.get("slab_operand_bytes") is None:
         return None
     from ..utils import budget
 
     _, parts = budget.estimate_run_bytes(
         stencil, grid, mesh=mesh, fuse=fuse, fuse_kind=fuse_kind,
-        periodic=periodic)
+        periodic=periodic, ensemble=ensemble,
+        ensemble_mesh=ensemble_mesh)
     slab = [b for label, b in parts
             if "operands only" in label and b > 0]
     if not slab:
@@ -396,6 +413,7 @@ def static_cost(
     hbm_gbs: float = V5E_HBM_GBS,
     ici_gbs: float = V5E_ICI_GBS,
     exchange: str = "ppermute",
+    ensemble_mesh: int = 0,
 ) -> Dict[str, Any]:
     """The manifest's static cost block: counters + roofline prediction.
 
@@ -408,15 +426,21 @@ def static_cost(
     """
     grid = tuple(int(g) for g in grid)
     local = _local_shape(grid, mesh)
-    batch = max(1, int(ensemble))
+    # per-DEVICE members (time-side terms) vs TOTAL members (cell
+    # throughput): an ensemble mesh axis spreads the batch over device
+    # groups, so a device pays for ensemble/ensemble_mesh members while
+    # the machine advances all of them
+    total_members = max(1, int(ensemble))
+    members = (total_members // max(1, int(ensemble_mesh))
+               if ensemble else 1)
     comm = comm_stats(stencil, grid, mesh, fuse=fuse, fuse_kind=fuse_kind,
-                      periodic=periodic, exchange=exchange)
-    flops = batch * step_flops(stencil, local, periodic=periodic)
-    hbm_b = hbm_bytes_per_step(stencil, local, fuse=fuse, batch=batch)
+                      periodic=periodic, exchange=exchange, batch=members)
+    flops = members * step_flops(stencil, local, periodic=periodic)
+    hbm_b = hbm_bytes_per_step(stencil, local, fuse=fuse, batch=members)
     t_hbm_ms = hbm_b / (hbm_gbs * 1e9) * 1e3
     t_ici_ms = (comm["ici_bytes_per_step"] / (ici_gbs * 1e9) * 1e3
                 if comm else 0.0)
-    cells = batch * math.prod(grid)
+    cells = total_members * math.prod(grid)
 
     def _mcells(t_ms: float) -> float:
         return cells / (t_ms * 1e-3) / 1e6 if t_ms > 0 else float("inf")
@@ -425,7 +449,10 @@ def static_cost(
         "grid": list(grid),
         "mesh": list(mesh),
         "local_shape": list(local),
-        "batch": batch,
+        "batch": total_members,
+        "ensemble": int(ensemble),
+        "ensemble_mesh": int(ensemble_mesh),
+        "members_per_device": members,
         "fuse": int(fuse),
         "fuse_kind": comm["kind"] if comm else (fuse_kind if fuse else None),
         "dtype": str(jnp.dtype(stencil.dtype)),
@@ -449,7 +476,8 @@ def static_cost(
     if comm and comm.get("slab_operand_bytes") is not None:
         try:
             out["budget_crosscheck"] = budget_crosscheck(
-                stencil, grid, mesh, fuse, fuse_kind, periodic=periodic)
+                stencil, grid, mesh, fuse, fuse_kind, periodic=periodic,
+                ensemble=ensemble, ensemble_mesh=ensemble_mesh)
         except Exception:  # noqa: BLE001 — the cross-check must never
             out["budget_crosscheck"] = None  # block a manifest write
     if comm and comm.get("exchange") == "rdma":

@@ -86,7 +86,7 @@ def key_id(key: Dict[str, Any]) -> str:
 
 def baseline_key(row: Dict[str, Any]) -> str:
     """Baseline identity for the gate: same label on the same backend
-    under the same EXCHANGE MODE.
+    under the same EXCHANGE MODE and the same ENSEMBLE SIZE.
 
     Deliberately coarser than :func:`key_id`: a BUILDER_REV bump or a
     flag change must still be COMPARED against the old number (that
@@ -95,12 +95,21 @@ def baseline_key(row: Dict[str, Any]) -> str:
     measurement must never be the baseline an rdma run is scored
     against (the transports are different execution paths; a label that
     exists in the ledger only under the other mode is NO_BASELINE, not
-    REGRESSED).  The mode rides the flags only when non-default, so
-    every pre-exchange row keeps its historical baseline key.
+    REGRESSED).  The same rule guards the ensemble axis (round 15): an
+    ``ens=8`` row aggregates 8 members' throughput, so judging it
+    against a single-sim baseline (or vice versa) would read the batch
+    multiplier as an 8x regression/improvement — across ensemble sizes
+    the gate reports NO_BASELINE instead.  Mode and ensemble ride the
+    flags only when non-default, so every pre-existing row keeps its
+    historical baseline key byte-for-byte.
     """
     k = row["key"]
-    mode = (k.get("flags") or {}).get("exchange")
+    flags = k.get("flags") or {}
+    mode = flags.get("exchange")
     tail = f"|{mode}" if mode else ""
+    ens = flags.get("ensemble")
+    if ens:
+        tail += f"|ens{ens}"
     return f"{k['label']}|{k.get('backend')}{tail}"
 
 
@@ -270,11 +279,16 @@ def _flags(run: Dict[str, Any]) -> Dict[str, Any]:
     out = {k: run.get(k) for k in ("fuse", "fuse_kind", "overlap",
                                    "pipeline")
            if run.get(k)}
-    # exchange mode is part of the row identity AND the baseline key
-    # (see baseline_key) — recorded only when non-default so every
-    # pre-existing key (and its best_known dedupe) stays byte-identical
+    # exchange mode and ensemble size are part of the row identity AND
+    # the baseline key (see baseline_key) — recorded only when
+    # non-default so every pre-existing key (and its best_known dedupe)
+    # stays byte-identical
     if run.get("exchange") and run["exchange"] != "ppermute":
         out["exchange"] = run["exchange"]
+    if run.get("ensemble"):
+        out["ensemble"] = run["ensemble"]
+        if run.get("ensemble_mesh"):
+            out["ensemble_mesh"] = run["ensemble_mesh"]
     return out
 
 
@@ -295,6 +309,10 @@ def _cli_label(run: Dict[str, Any]) -> str:
         parts.append("pipeline")
     if run.get("exchange") and run["exchange"] != "ppermute":
         parts.append(str(run["exchange"]))
+    if run.get("ensemble"):
+        parts.append(f"ens{run['ensemble']}")
+        if run.get("ensemble_mesh"):
+            parts.append(f"ensmesh{run['ensemble_mesh']}")
     return "cli_" + "_".join(p for p in parts if p)
 
 
@@ -313,6 +331,8 @@ def _scaling_label(run: Dict[str, Any], rung: Dict[str, Any]) -> str:
         parts.append("pipeline")
     if rung.get("exchange") and rung["exchange"] != "ppermute":
         parts.append(str(rung["exchange"]))
+    if rung.get("ensemble"):
+        parts.append(f"ens{rung['ensemble']}")
     return "_".join(parts)
 
 
@@ -465,7 +485,9 @@ def rows_from_log(log_path: str) -> List[Dict[str, Any]]:
                                                 "pipeline") if e.get(k)},
                        **({"exchange": e["exchange"]}
                           if e.get("exchange")
-                          and e["exchange"] != "ppermute" else {})},
+                          and e["exchange"] != "ppermute" else {}),
+                       **({"ensemble": e["ensemble"]}
+                          if e.get("ensemble") else {})},
                 builder_rev=prov.get("builder_rev"),
                 unit=("Mcells/s" if e.get("mcells_per_s") is not None
                       else "ms/step")))

@@ -323,6 +323,7 @@ class RunMetrics:
         self.errors: Deque[Dict[str, Any]] = \
             collections.deque(maxlen=max_errors)
         self._cells: Optional[int] = None
+        self._members: int = 0  # ensemble size (0 = unbatched run)
 
     # -- ingestion ------------------------------------------------------
 
@@ -364,11 +365,20 @@ class RunMetrics:
         run = rec.get("run") or {}
         prov = rec.get("provenance") or {}
         self._cells = _grid_cells(run)
+        ens = run.get("ensemble")
+        if isinstance(ens, int) and ens > 0:
+            # a batched run must be distinguishable from a fast single
+            # run at a glance: the size is a gauge AND an identity label
+            self._members = ens
+            self.registry.gauge(
+                "obs_ensemble_size",
+                "simultaneous simulations in the batched step").set(ens)
         self.registry.info(
             "obs_run_info", "identity of the (primary) run").set(
             tool=rec.get("tool"), stencil=run.get("stencil"),
             grid=",".join(map(str, run.get("grid") or [])) or None,
             mesh=",".join(map(str, run.get("mesh") or [])) or None,
+            ensemble=ens if ens else None,
             backend=prov.get("backend"),
             device_kind=prov.get("device_kind"),
             hostname=prov.get("hostname"),
@@ -405,10 +415,16 @@ class RunMetrics:
             self.registry.gauge("obs_steps_per_s",
                                 "latest chunk steps/s").set(rate)
             if self._cells:
+                agg = self._cells * rate / 1e9
                 self.registry.gauge(
                     "obs_gcells_per_s",
-                    "latest chunk throughput, Gcells/s").set(
-                    self._cells * rate / 1e9)
+                    "latest chunk AGGREGATE throughput, Gcells/s "
+                    "(all ensemble members)").set(agg)
+                if self._members:
+                    self.registry.gauge(
+                        "obs_member_gcells_per_s",
+                        "latest chunk per-member throughput, "
+                        "Gcells/s").set(agg / self._members)
         mem = rec.get("memory") or {}
         peak = mem.get("peak_bytes_in_use")
         if peak is not None:
@@ -537,12 +553,20 @@ class RunMetrics:
                         if c.get("chunk") != 0 and not c.get("recompiled")
                         and c.get("ms_per_step") is not None)
         out: Dict[str, Any] = {}
+        if self._members:
+            out["ensemble"] = self._members
         last = self.chunks_recent[-1] if self.chunks_recent else None
         if last and last.get("wall_s") and last.get("steps"):
             rate = last["steps"] / last["wall_s"]
             out["steps_per_s"] = round(rate, 3)
             if self._cells:
-                out["gcells_per_s"] = round(self._cells * rate / 1e9, 4)
+                agg = self._cells * rate / 1e9
+                out["gcells_per_s"] = round(agg, 4)
+                if self._members:
+                    # aggregate AND per-member: the batched-vs-fast
+                    # ambiguity resolved in one read
+                    out["gcells_per_s_per_member"] = round(
+                        agg / self._members, 4)
         if steady:
             out["steady_ms_per_step_p50"] = quantile(steady, 0.5)
             out["steady_ms_per_step_p90"] = quantile(steady, 0.9)

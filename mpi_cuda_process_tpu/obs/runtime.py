@@ -98,10 +98,16 @@ class RuntimeRecorder:
     extends to the profiler — pinned by tests/test_obs_profile.py).
     """
 
-    def __init__(self, trace=None, step_unit: int = 1, profiler=None):
+    def __init__(self, trace=None, step_unit: int = 1, profiler=None,
+                 ensemble: int = 0):
         self.trace = trace
         self.profiler = profiler
         self.step_unit = max(1, int(step_unit))
+        # batched runs: member count stamped on every chunk record so a
+        # batched run is distinguishable from a fast single run in the
+        # raw stream (aggregate vs per-member throughput is then one
+        # division away — obs/metrics.RunMetrics does it)
+        self.ensemble = max(0, int(ensemble))
         self.chunks: List[Dict[str, Any]] = []
         self.recompiles = 0
         self.last_progress = time.monotonic()
@@ -151,6 +157,10 @@ class RuntimeRecorder:
             "ms_per_step": round(seconds * 1e3 / max(1, real_steps), 6),
             "recompiled": recompiled,
         }
+        if self.ensemble:
+            # every member advanced the same real_steps this chunk —
+            # the batched step is one program over all N
+            rec["members"] = self.ensemble
         if profiled:
             rec["profiled"] = True
         mem = device_memory_stats()

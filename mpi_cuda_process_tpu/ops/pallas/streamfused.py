@@ -711,6 +711,7 @@ def make_stream_fused_step(
     k: int,
     tiles: Optional[Tuple[int, ...]] = None,  # (bz, by[, bx])
     interpret: Optional[bool] = None,
+    batch: int = 0,
 ):
     """Build ``fields -> fields`` advancing ``k`` steps in one streaming
     pass, or None when the shape can't host the sliding window.
@@ -719,6 +720,17 @@ def make_stream_fused_step(
     (guard-frame semantics; tests/test_streamfused.py).  Unlike the tiled
     kernels there is NO ``2*k*halo % sublane`` gate — bf16 runs at k=4.
     Guard-frame (non-periodic) only.
+
+    ``batch=N`` (round 15, the ensemble engine): the step takes/returns
+    fields with a leading member axis and the pallas grid gains an
+    EXPLICIT leading batch dimension — ``(N, *strip_grid)`` — so all N
+    members stream through the same compiled kernel, one member's full
+    strip sweep per batch index (the VMEM ring re-primes at each new
+    batch index exactly as it does at each new strip; per-member
+    equivalence and the batched grid are pinned by
+    tests/test_ensemble_engine.py).  Implemented through vmap's
+    ``pallas_call`` batching rule, which constructs exactly that
+    batched grid; the manual-DMA schedule is untouched.
     """
     if not stream_supported(stencil):
         return None
@@ -752,5 +764,18 @@ def make_stream_fused_step(
 
     def step_k(fields: Fields) -> Fields:
         return tuple(call(*fields))
+
+    if batch:
+        batched = jax.vmap(step_k)
+
+        def step_k_batched(fields: Fields) -> Fields:
+            if fields[0].shape != (batch, Z, Y, X):
+                raise ValueError(
+                    f"batched streaming step wants fields "
+                    f"({batch}, {Z}, {Y}, {X}), got {fields[0].shape}")
+            return batched(fields)
+
+        step_k_batched._ensemble = int(batch)
+        return step_k_batched
 
     return step_k

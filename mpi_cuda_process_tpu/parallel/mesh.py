@@ -20,6 +20,13 @@ from jax.sharding import Mesh
 # Spatial mesh axis names, aligned with grid axes 0..ndim-1.
 SPATIAL_AXES: Tuple[str, ...] = ("sx", "sy", "sz")
 
+# The ensemble/batch mesh axis (round 15): a LEADING axis that shards
+# the member dimension of a batched run — ``ensemble x y x z``, e.g. a
+# v5e-64 as 8x8 spatial x N-way ensemble.  Spatially it is invisible:
+# every halo ppermute names a spatial axis only, so exchanges stay
+# within each member's spatial subgrid by construction.
+ENSEMBLE_AXIS = "ens"
+
 
 def spatial_axis_names(ndim: int) -> Tuple[str, ...]:
     return SPATIAL_AXES[:ndim]
@@ -28,22 +35,37 @@ def spatial_axis_names(ndim: int) -> Tuple[str, ...]:
 def make_mesh(
     mesh_shape: Sequence[int],
     devices: Optional[Sequence[jax.Device]] = None,
+    ensemble: int = 1,
 ) -> Mesh:
     """Build a Mesh whose axes 0..n-1 decompose grid axes 0..n-1.
 
     ``mesh_shape`` is per-grid-axis shard counts, e.g. ``(2, 2)`` for the
     BASELINE.json config-3 decomposition.  Trailing grid axes beyond
     ``len(mesh_shape)`` are unsharded.
+
+    ``ensemble > 1`` prepends the :data:`ENSEMBLE_AXIS` with that many
+    shards — the third mesh dimension of a batched run (member blocks
+    spread over ``ensemble`` device groups, each group an independent
+    spatial mesh).  The spatial layout within each group is identical to
+    the ``ensemble == 1`` mesh, so neighbor resolution (ppermute rings,
+    ``halo.neighbor_logical_ids``) is untouched.
     """
     mesh_shape = tuple(int(s) for s in mesh_shape)
-    n = int(np.prod(mesh_shape))
+    ensemble = max(1, int(ensemble))
+    n = int(np.prod(mesh_shape)) * ensemble
     if devices is None:
         devices = jax.devices()
     if n > len(devices):
         raise ValueError(
-            f"mesh {mesh_shape} needs {n} devices, have {len(devices)}"
+            f"mesh {mesh_shape}"
+            + (f" x {ensemble}-way ensemble" if ensemble > 1 else "")
+            + f" needs {n} devices, have {len(devices)}"
         )
     names = spatial_axis_names(len(mesh_shape))
+    if ensemble > 1:
+        dev_array = np.asarray(devices[:n]).reshape(
+            (ensemble,) + mesh_shape)
+        return Mesh(dev_array, (ENSEMBLE_AXIS,) + names)
     dev_array = np.asarray(devices[:n]).reshape(mesh_shape)
     return Mesh(dev_array, names)
 

@@ -57,11 +57,75 @@ def grid_partition_spec(ndim: int, mesh: Mesh) -> P:
     return P(*[n if n in mesh.shape else None for n in names])
 
 
-def shard_fields(fields: Fields, mesh: Mesh, ndim: int) -> Fields:
-    """Place fields on the mesh with the grid decomposition sharding."""
-    spec = grid_partition_spec(ndim, mesh)
+def ensemble_partition_spec(ndim: int, mesh: Mesh) -> P:
+    """PartitionSpec for BATCHED fields ``(members, *grid)``: the leading
+    member axis is sharded over the ensemble mesh axis when the mesh
+    carries one (``mesh.ENSEMBLE_AXIS``), else fully local; grid axes
+    exactly as :func:`grid_partition_spec`."""
+    from .mesh import ENSEMBLE_AXIS
+
+    sp = grid_partition_spec(ndim, mesh)
+    lead = ENSEMBLE_AXIS if ENSEMBLE_AXIS in mesh.shape else None
+    return P(lead, *sp)
+
+
+def ensemble_members_local(mesh: Mesh, ensemble: int) -> int:
+    """Members each device holds: ``ensemble / ens-axis shards``.
+
+    The single validation point for the batched steppers: the member
+    count must divide over the ensemble mesh axis (and an ensemble mesh
+    axis is meaningless without a batched run)."""
+    from .mesh import ENSEMBLE_AXIS
+
+    n_shards = int(mesh.shape.get(ENSEMBLE_AXIS, 1))
+    if not ensemble:
+        if n_shards > 1:
+            raise ValueError(
+                f"mesh carries a {n_shards}-way ensemble axis but the "
+                "run is unbatched (ensemble=0) — drop the axis or pass "
+                "ensemble=N")
+        return 0
+    if int(ensemble) % n_shards:
+        raise ValueError(
+            f"ensemble={ensemble} not divisible by the ensemble mesh "
+            f"axis ({n_shards} shards)")
+    return int(ensemble) // n_shards
+
+
+def shard_fields(fields: Fields, mesh: Mesh, ndim: int,
+                 ensemble: bool = False) -> Fields:
+    """Place fields on the mesh with the grid decomposition sharding.
+
+    ``ensemble=True``: the fields carry a leading member axis, sharded
+    over the ensemble mesh axis when present
+    (:func:`ensemble_partition_spec`)."""
+    spec = ensemble_partition_spec(ndim, mesh) if ensemble else \
+        grid_partition_spec(ndim, mesh)
     sharding = NamedSharding(mesh, spec)
     return tuple(jax.device_put(f, sharding) for f in fields)
+
+
+def _member_shard_map(fn, mesh, ndim, ensemble, n_in=1, n_out=1):
+    """``shard_map`` a per-member local function over the mesh.
+
+    The single batching point of every sharded stepper (round 15): with
+    ``ensemble`` the local function — written for ONE member's block —
+    is ``jax.vmap``ped over the device's local member axis and the specs
+    gain the leading ensemble entry.  vmap's collective batching rule
+    folds the member axis INTO each ppermute operand (one collective
+    per exchange site regardless of N — the structural pin of
+    ``utils/jaxprcheck.assert_ensemble_exchange_invariance``), and its
+    ``pallas_call`` rule prepends an explicit batch grid dimension to
+    every kernel, so the batched step is the same program the unbatched
+    step compiles plus one grid axis — compiled ONCE for all members.
+    """
+    spec = grid_partition_spec(ndim, mesh)
+    if ensemble:
+        fn = jax.vmap(fn)
+        spec = ensemble_partition_spec(ndim, mesh)
+    return shard_map(fn, mesh=mesh, in_specs=(spec,) * n_in,
+                     out_specs=spec if n_out == 1 else (spec,) * n_out,
+                     check_vma=False)
 
 
 def _resolve_mesh_axes(ndim: int, mesh: Mesh):
@@ -138,6 +202,7 @@ def make_sharded_step(
     periodic: bool = False,
     compute_fn: Optional[Callable[[Fields], Fields]] = None,
     overlap: bool = False,
+    ensemble: int = 0,
 ):
     """Build the SPMD step function for ``stencil`` decomposed over ``mesh``.
 
@@ -156,9 +221,16 @@ def make_sharded_step(
     from exchanged data and spliced over the bulk result.  With
     ``overlap=False`` (default, option (a)) the whole block update consumes
     the exchanged padding and overlap is left entirely to XLA.
+
+    ``ensemble=N``: the step takes/returns fields with a leading member
+    axis (N independent universes), sharded over the mesh's ensemble
+    axis when present; the local update is vmapped per member
+    (:func:`_member_shard_map`) — one exchange round per site regardless
+    of N, one compile for the whole batch.
     """
     ndim = stencil.ndim
     halo = stencil.halo
+    ensemble_members_local(mesh, ensemble)
     axis_names, counts = _resolve_mesh_axes(ndim, mesh)
     for d, c in enumerate(counts):
         if global_shape[d] % c:
@@ -193,7 +265,6 @@ def make_sharded_step(
                 f"odd extents {tuple(global_shape)} makes the coloring "
                 f"inconsistent")
     update_fns = stencil.phases or (compute_fn or stencil.update,)
-    spec = grid_partition_spec(ndim, mesh)
 
     sharded_axes = [d for d, c in enumerate(counts) if c > 1]
     no_names = (None,) * ndim
@@ -279,13 +350,9 @@ def make_sharded_step(
 
     # check_vma=False: pallas_call outputs carry no varying-mesh-axes
     # annotation, which the default vma check rejects inside shard_map.
-    return shard_map(
-        local_step,
-        mesh=mesh,
-        in_specs=(spec,),
-        out_specs=spec,
-        check_vma=False,
-    )
+    step = _member_shard_map(local_step, mesh, ndim, ensemble)
+    step._ensemble = int(ensemble)
+    return step
 
 
 def make_sharded_fused_step(
@@ -300,8 +367,19 @@ def make_sharded_fused_step(
     overlap: bool = False,
     pipeline: bool = False,
     exchange: Optional[str] = None,
+    ensemble: int = 0,
 ):
     """Temporal blocking under domain decomposition: k steps per exchange.
+
+    ``ensemble=N`` (round 15): the step takes/returns fields with a
+    leading member axis, sharded over the mesh's ensemble axis when
+    present (``mesh.ENSEMBLE_AXIS``); every local function — plain,
+    overlapped, and the pipeline prologue/body — is vmapped per member
+    through :func:`_member_shard_map`, so the exchange-round count per
+    pass is independent of N (vmap folds the member axis into each
+    ppermute operand) and every Pallas kernel gains one leading batch
+    grid dimension.  Composes with overlap, pipeline, and
+    ``exchange="rdma"`` on every kind this function hosts.
 
     The distributed analogue of ``ops.pallas.fused.make_fused_step`` — and
     the configuration the 4096^3 north star actually needs (BASELINE.json
@@ -473,6 +551,7 @@ def make_sharded_fused_step(
             "for periodic meshes")
     if ndim != 3 or not fused_supported(stencil):
         return None
+    ensemble_members_local(mesh, ensemble)
     axis_names, counts = _resolve_mesh_axes(ndim, mesh)
     if counts[2] > 1:
         return None  # lane axis must stay whole (in-kernel lane rolls)
@@ -496,11 +575,13 @@ def make_sharded_fused_step(
             return _make_yzslab_padfree_step(
                 stencil, mesh, global_shape, local_shape, axis_names,
                 counts, k, interpret, periodic, overlap=overlap,
-                stream=True, pipeline=pipeline, exchange=exchange)
+                stream=True, pipeline=pipeline, exchange=exchange,
+                ensemble=ensemble)
         return _make_zslab_padfree_step(
             stencil, mesh, global_shape, local_shape, axis_names, counts,
             k, build_stream_sharded_call, (1, 1), interpret, periodic,
-            overlap=overlap, pipeline=pipeline, exchange=exchange)
+            overlap=overlap, pipeline=pipeline, exchange=exchange,
+            ensemble=ensemble)
     forced_padfree = kind == "padfree"
     if forced_padfree:
         padfree = True
@@ -521,7 +602,8 @@ def make_sharded_fused_step(
             step = _make_zslab_padfree_step(
                 stencil, mesh, global_shape, local_shape, axis_names,
                 counts, k, build_zslab_padfree_call, (9, 3), interpret,
-                periodic, overlap=overlap, pipeline=pipeline)
+                periodic, overlap=overlap, pipeline=pipeline,
+                ensemble=ensemble)
             if step is None:
                 # whole-row windows exceed VMEM (wide X x multi-field):
                 # the wide-X kernel windows the lane axis too
@@ -530,7 +612,8 @@ def make_sharded_fused_step(
                 step = _make_zslab_padfree_step(
                     stencil, mesh, global_shape, local_shape, axis_names,
                     counts, k, build_zslab_xwin_call, (27, 9), interpret,
-                    periodic, overlap=overlap, pipeline=pipeline)
+                    periodic, overlap=overlap, pipeline=pipeline,
+                    ensemble=ensemble)
         else:
             # y (or y+z) sharded: the 2-axis slab-operand kernels — y
             # slabs + two-pass-composed corner operands, selects on both
@@ -538,7 +621,7 @@ def make_sharded_fused_step(
             step = _make_yzslab_padfree_step(
                 stencil, mesh, global_shape, local_shape, axis_names,
                 counts, k, interpret, periodic, overlap=overlap,
-                pipeline=pipeline)
+                pipeline=pipeline, ensemble=ensemble)
         if step is not None:
             return step
         if forced_padfree:
@@ -570,7 +653,6 @@ def make_sharded_fused_step(
     # (one-shard-neighbor invariant — a width-m slab must come from a single
     # neighbor — is already guaranteed: _pick_tiles only accepts local z/y
     # extents divisible by tiles that are multiples of 2*m)
-    spec = grid_partition_spec(ndim, mesh)
     sharded_axes = [d for d in (0, 1) if counts[d] > 1]
 
     shells = None
@@ -605,13 +687,9 @@ def make_sharded_fused_step(
         return tuple(call(*args))
 
     if shells is None:
-        return shard_map(
-            local_step,
-            mesh=mesh,
-            in_specs=(spec,),
-            out_specs=spec,
-            check_vma=False,
-        )
+        step = _member_shard_map(local_step, mesh, ndim, ensemble)
+        step._ensemble = int(ensemble)
+        return step
 
     def local_interior(fields: Fields):
         # LOCAL bc/wrap pad only — no ppermute anywhere on this path, so
@@ -673,18 +751,19 @@ def make_sharded_fused_step(
                             (slice(None),) * d + (sl,)].set(shell_out[i])
         return tuple(out)
 
-    return _attach_overlap(
-        shard_map(local_step_overlap, mesh=mesh, in_specs=(spec,),
-                  out_specs=spec, check_vma=False),
-        shard_map(local_interior, mesh=mesh, in_specs=(spec,),
-                  out_specs=spec, check_vma=False),
+    step = _attach_overlap(
+        _member_shard_map(local_step_overlap, mesh, ndim, ensemble),
+        _member_shard_map(local_interior, mesh, ndim, ensemble),
     )
+    step._ensemble = int(ensemble)
+    return step
 
 
 def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
                              axis_names, counts, k, build_call, layout,
                              interpret, periodic, overlap=False,
-                             pipeline=False, exchange="ppermute"):
+                             pipeline=False, exchange="ppermute",
+                             ensemble=0):
     """shard_map wrapper for the z-slab pad-free fused kernels: width-m
     slab exchange (no concatenation, no padded copy), slabs handed to the
     kernel as operands, frame from SMEM origin scalars.  ``layout`` is
@@ -723,7 +802,6 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
     # actually carries the step (the builders silently fall back)
     kind_name = {(9, 3): "zslab", (27, 9): "zslab_xwin",
                  (1, 1): "stream"}[layout]
-    spec = grid_partition_spec(3, mesh)
 
     transport = None
     if exchange == "rdma":
@@ -759,14 +837,9 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
         return tuple(call(_origins(), *args))
 
     if shells is None and not pipeline:
-        step = shard_map(
-            local_step,
-            mesh=mesh,
-            in_specs=(spec,),
-            out_specs=spec,
-            check_vma=False,
-        )
+        step = _member_shard_map(local_step, mesh, 3, ensemble)
         step._padfree_kind = kind_name
+        step._ensemble = int(ensemble)
         return _attach_exchange(step, exchange, transport)
 
     Lz = local_shape[0]
@@ -861,23 +934,21 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
                         for i, bc in enumerate(stencil.bc_value))
                 return tuple(out), new_slabs
 
-        prologue_sm = shard_map(local_prologue, mesh=mesh,
-                                in_specs=(spec,), out_specs=spec,
-                                check_vma=False)
-        body_sm = shard_map(local_body, mesh=mesh, in_specs=(spec, spec),
-                            out_specs=(spec, spec), check_vma=False)
+        prologue_sm = _member_shard_map(local_prologue, mesh, 3, ensemble)
+        body_sm = _member_shard_map(local_body, mesh, 3, ensemble,
+                                    n_in=2, n_out=2)
 
         def stepper(fields: Fields) -> Fields:
             return body_sm(fields, prologue_sm(fields))[0]
 
         interior_sm = None
         if shells is not None:
-            interior_sm = shard_map(local_interior, mesh=mesh,
-                                    in_specs=(spec,), out_specs=spec,
-                                    check_vma=False)
+            interior_sm = _member_shard_map(local_interior, mesh, 3,
+                                            ensemble)
         step = _attach_pipeline(stepper, prologue_sm, body_sm,
                                 interior_step=interior_sm)
         step._padfree_kind = kind_name
+        step._ensemble = int(ensemble)
         return _attach_exchange(step, exchange, transport)
 
     def local_step_overlap(fields: Fields) -> Fields:
@@ -921,19 +992,19 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
         return tuple(out)
 
     step = _attach_overlap(
-        shard_map(local_step_overlap, mesh=mesh, in_specs=(spec,),
-                  out_specs=spec, check_vma=False),
-        shard_map(local_interior, mesh=mesh, in_specs=(spec,),
-                  out_specs=spec, check_vma=False),
+        _member_shard_map(local_step_overlap, mesh, 3, ensemble),
+        _member_shard_map(local_interior, mesh, 3, ensemble),
     )
     step._padfree_kind = kind_name
+    step._ensemble = int(ensemble)
     return _attach_exchange(step, exchange, transport)
 
 
 def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
                               axis_names, counts, k, interpret, periodic,
                               overlap=False, stream=False,
-                              pipeline=False, exchange="ppermute"):
+                              pipeline=False, exchange="ppermute",
+                              ensemble=0):
     """shard_map wrapper for the 2-AXIS pad-free fused kernels
     (y-sharded and y+z-sharded meshes): width-m slab exchange on both
     wall axes plus the four corner pieces by two-pass composition
@@ -1005,7 +1076,6 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
         return None
     call, m_built, nfields = built
     assert m_built == m
-    spec = grid_partition_spec(3, mesh)
     names2 = (axis_names[0], axis_names[1])
     counts2 = (counts[0], counts[1])
     sharded_axes = [d for d in (0, 1) if counts[d] > 1]
@@ -1070,14 +1140,9 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
         return tuple(call(_origins(), *_kernel_args(fields, ex)))
 
     if shells is None and not pipeline:
-        step = shard_map(
-            local_step,
-            mesh=mesh,
-            in_specs=(spec,),
-            out_specs=spec,
-            check_vma=False,
-        )
+        step = _member_shard_map(local_step, mesh, 3, ensemble)
         step._padfree_kind = kind_name
+        step._ensemble = int(ensemble)
         return _attach_exchange(step, exchange, transport)
 
     Lz, Ly = local_shape[0], local_shape[1]
@@ -1187,23 +1252,21 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
                                 transport=transport))
                 return tuple(out), tuple(new_slabs)
 
-        prologue_sm = shard_map(local_prologue, mesh=mesh,
-                                in_specs=(spec,), out_specs=spec,
-                                check_vma=False)
-        body_sm = shard_map(local_body, mesh=mesh, in_specs=(spec, spec),
-                            out_specs=(spec, spec), check_vma=False)
+        prologue_sm = _member_shard_map(local_prologue, mesh, 3, ensemble)
+        body_sm = _member_shard_map(local_body, mesh, 3, ensemble,
+                                    n_in=2, n_out=2)
 
         def stepper(fields: Fields) -> Fields:
             return body_sm(fields, prologue_sm(fields))[0]
 
         interior_sm = None
         if shells is not None:
-            interior_sm = shard_map(local_interior, mesh=mesh,
-                                    in_specs=(spec,), out_specs=spec,
-                                    check_vma=False)
+            interior_sm = _member_shard_map(local_interior, mesh, 3,
+                                            ensemble)
         step = _attach_pipeline(stepper, prologue_sm, body_sm,
                                 interior_step=interior_sm)
         step._padfree_kind = kind_name
+        step._ensemble = int(ensemble)
         return _attach_exchange(step, exchange, transport)
 
     def local_step_overlap(fields: Fields) -> Fields:
@@ -1232,12 +1295,11 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
         return tuple(out)
 
     step = _attach_overlap(
-        shard_map(local_step_overlap, mesh=mesh, in_specs=(spec,),
-                  out_specs=spec, check_vma=False),
-        shard_map(local_interior, mesh=mesh, in_specs=(spec,),
-                  out_specs=spec, check_vma=False),
+        _member_shard_map(local_step_overlap, mesh, 3, ensemble),
+        _member_shard_map(local_interior, mesh, 3, ensemble),
     )
     step._padfree_kind = kind_name
+    step._ensemble = int(ensemble)
     return _attach_exchange(step, exchange, transport)
 
 
@@ -1249,6 +1311,7 @@ def make_sharded_fullgrid_step(
     interpret: Optional[bool] = None,
     periodic: bool = False,
     overlap: bool = False,
+    ensemble: int = 0,
 ):
     """2D temporal blocking under row decomposition: k steps per exchange.
 
@@ -1281,6 +1344,7 @@ def make_sharded_fullgrid_step(
     ndim = stencil.ndim
     if ndim != 2:
         return None
+    ensemble_members_local(mesh, ensemble)
     axis_names, counts = _resolve_mesh_axes(ndim, mesh)
     if counts[1] > 1:
         return None  # lane axis must stay whole (in-kernel lane rolls)
@@ -1301,7 +1365,6 @@ def make_sharded_fullgrid_step(
         return None
     call, nfields = built
     assert nfields == stencil.num_fields
-    spec = grid_partition_spec(ndim, mesh)
 
     shell_call = None
     if overlap and counts[0] > 1 and local_shape[0] >= 3 * m:
@@ -1338,13 +1401,9 @@ def make_sharded_fullgrid_step(
         return tuple(call(_origin(_y0()), *padded))
 
     if shell_call is None:
-        return shard_map(
-            local_step,
-            mesh=mesh,
-            in_specs=(spec,),
-            out_specs=spec,
-            check_vma=False,
-        )
+        step = _member_shard_map(local_step, mesh, ndim, ensemble)
+        step._ensemble = int(ensemble)
+        return step
 
     Ly = local_shape[0]
     w = 2 * m
@@ -1389,12 +1448,12 @@ def make_sharded_fullgrid_step(
                 out[i] = out[i].at[Ly - w:].set(hi_out[i])
         return tuple(out)
 
-    return _attach_overlap(
-        shard_map(local_step_overlap, mesh=mesh, in_specs=(spec,),
-                  out_specs=spec, check_vma=False),
-        shard_map(local_interior, mesh=mesh, in_specs=(spec,),
-                  out_specs=spec, check_vma=False),
+    step = _attach_overlap(
+        _member_shard_map(local_step_overlap, mesh, ndim, ensemble),
+        _member_shard_map(local_interior, mesh, ndim, ensemble),
     )
+    step._ensemble = int(ensemble)
+    return step
 
 
 def make_sharded_temporal_step(
@@ -1408,6 +1467,7 @@ def make_sharded_temporal_step(
     overlap: bool = False,
     pipeline: bool = False,
     exchange: Optional[str] = None,
+    ensemble: int = 0,
 ):
     """Temporal blocking under decomposition, any dimensionality.
 
@@ -1448,8 +1508,8 @@ def make_sharded_temporal_step(
                 "2D grids")
         return None if kind else make_sharded_fullgrid_step(
             stencil, mesh, global_shape, k, interpret=interpret,
-            periodic=periodic, overlap=overlap)
+            periodic=periodic, overlap=overlap, ensemble=ensemble)
     return make_sharded_fused_step(
         stencil, mesh, global_shape, k, interpret=interpret,
         periodic=periodic, kind=kind, overlap=overlap,
-        pipeline=pipeline, exchange=exchange)
+        pipeline=pipeline, exchange=exchange, ensemble=ensemble)

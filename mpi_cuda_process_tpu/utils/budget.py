@@ -65,6 +65,7 @@ def estimate_run_bytes(
     overlap: bool = False,
     pipeline: bool = False,
     exchange: str = "ppermute",
+    ensemble_mesh: int = 0,
 ) -> Tuple[int, List[Tuple[str, int]]]:
     """Peak per-device live bytes for a run, with a labeled breakdown.
 
@@ -73,6 +74,13 @@ def estimate_run_bytes(
     SMEM-origin frame) variants, the raw whole-step kernels (no
     transient: the state is its own halo), and the jnp pad -> update
     path.  Returns ``(total, [(label, bytes), ...])``.
+
+    ``ensemble=N`` prices the batched run (round 15 — the UNBUILDABLE
+    ensemble wall is gone: every kind that builds unbatched builds
+    batched, the kernels gain one batch grid dimension and the slab /
+    pad transients scale with the members a device actually holds).
+    ``ensemble_mesh=M`` shards the member axis over M device groups, so
+    every per-device term scales by ``N / M`` members instead of N.
 
     ``exchange="rdma"`` (streaming kind under a mesh only — every other
     combination refuses before allocating, and the estimate says so):
@@ -88,7 +96,14 @@ def estimate_run_bytes(
     """
     itemsize = jnp.dtype(stencil.dtype).itemsize
     nfields = stencil.num_fields
-    batch = max(1, int(ensemble))
+    ens_shards = max(1, int(ensemble_mesh))
+    if ensemble and int(ensemble) % ens_shards:
+        raise ValueError(
+            f"ensemble={ensemble} not divisible by "
+            f"ensemble_mesh={ensemble_mesh} (the run refuses before "
+            "allocating)")
+    # per-DEVICE members: the batch each device actually holds
+    batch = max(1, int(ensemble)) // ens_shards if ensemble else 1
     local = _local_shape(grid, mesh)
     cells = batch * math.prod(local)
     field_b = cells * itemsize
@@ -299,25 +314,25 @@ def estimate_run_bytes(
             # HBM holds only state + output.  Probe construction (pure
             # Python) so a "fits" never describes an unconstructible run;
             # when unbuildable, cli.build refuses before any allocation.
-            # The unsharded kernel is guard-frame, unbatched only
-            # (cli.build rejects --periodic/--ensemble before building),
-            # so those configs are UNBUILDABLE here too — the estimate
-            # must describe the path the run actually takes.
+            # The unsharded kernel is guard-frame only; --ensemble now
+            # BATCHES it (round 15: an explicit leading batch grid
+            # dimension — the old "unbatched only" wall is deleted), so
+            # only periodic wrap and untileable shapes refuse.
             from ..ops.pallas.streamfused import make_stream_fused_step
 
-            # `not ensemble`, not `batch == 1`: cli rejects ANY truthy
-            # --ensemble (including 1), and batch folds 0 and 1 together
-            ok = (not periodic and not ensemble
+            ok = (not periodic
                   and make_stream_fused_step(stencil, grid, fuse,
                                              interpret=True) is not None)
             if ok:
-                label = "streaming fused: no pad transient"
-            elif periodic or ensemble:
-                # name the flags, not the shape: the fix is dropping
-                # --periodic/--ensemble, not resizing the grid
+                label = ("streaming fused: no pad transient"
+                         + (f" ({batch} members batched)"
+                            if ensemble else ""))
+            elif periodic:
+                # name the flag, not the shape: the fix is dropping
+                # --periodic, not resizing the grid
                 label = ("streaming fused: UNBUILDABLE — stream is "
-                         "guard-frame, unbatched only (the run refuses "
-                         "before allocating)")
+                         "guard-frame only (the run refuses before "
+                         "allocating)")
             else:
                 label = ("streaming fused: UNBUILDABLE for this shape "
                          "(the run refuses before allocating)")
@@ -389,6 +404,7 @@ def check_budget(
     overlap: bool = False,
     pipeline: bool = False,
     exchange: str = "ppermute",
+    ensemble_mesh: int = 0,
 ) -> Tuple[int, List[Tuple[str, int]]]:
     """Raise ValueError with the arithmetic when the run cannot fit.
 
@@ -398,7 +414,8 @@ def check_budget(
     total, parts = estimate_run_bytes(
         stencil, grid, mesh=mesh, fuse=fuse, ensemble=ensemble,
         periodic=periodic, compute=compute, fuse_kind=fuse_kind,
-        overlap=overlap, pipeline=pipeline, exchange=exchange)
+        overlap=overlap, pipeline=pipeline, exchange=exchange,
+        ensemble_mesh=ensemble_mesh)
     if total > hbm:
         raise ValueError(
             f"config needs ~{total / 2**30:.2f} GiB per device but HBM is "
@@ -407,5 +424,8 @@ def check_budget(
             + "\nLevers: --dtype bfloat16 halves state bytes; a larger "
             "--mesh shrinks the per-device block; --fuse on a "
             f"{'pad-free eligible' if not mesh else 'sharded'} grid avoids "
-            "pad transients; --mem-check warn overrides this guard.")
+            "pad transients"
+            + ("; --ensemble-mesh spreads the members over more devices"
+               if ensemble else "")
+            + "; --mem-check warn overrides this guard.")
     return total, parts

@@ -36,6 +36,36 @@ def _pin_frame(x: jax.Array, value, width: int) -> jax.Array:
     return jnp.where(mask, jnp.asarray(value, x.dtype), x)
 
 
+def perturb_member(fields: Fields, stencil: Stencil, member: int,
+                   seed: int, perturb: float,
+                   periodic: bool = False) -> Fields:
+    """Per-member parameter perturbation of an initial state.
+
+    The ensemble engine's init diversifier (round 15): member ``i``'s
+    inexact fields are scaled by ``1 + perturb * u_i`` with
+    ``u_i ~ U(-1, 1)`` drawn from a key derived from ``(seed, member)``
+    — deterministic per member, identical across resumes and mesh
+    shapes.  Guard-frame values are re-pinned afterwards so the
+    Dirichlet walls stay exact; integer fields (Life occupancy) pass
+    through untouched.  ``perturb == 0`` is the identity.
+    """
+    if not perturb:
+        return fields
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), int(member))
+    u = jax.random.uniform(key, (), jnp.float32, -1.0, 1.0)
+    out = []
+    for f, bc in zip(fields, stencil.bc_value):
+        if not jnp.issubdtype(f.dtype, jnp.inexact):
+            out.append(f)
+            continue
+        g = (f.astype(jnp.float32)
+             * (1.0 + jnp.float32(perturb) * u)).astype(f.dtype)
+        if not periodic:
+            g = _pin_frame(g, bc, stencil.halo)
+        out.append(g)
+    return tuple(out)
+
+
 def init_state(
     stencil: Stencil,
     grid_shape: Sequence[int],
@@ -44,6 +74,7 @@ def init_state(
     kind: str = "auto",
     periodic: bool = False,
     ensemble: int = 0,
+    perturb: float = 0.0,
 ) -> Fields:
     """Build the initial fields for ``stencil`` on ``grid_shape``.
 
@@ -55,7 +86,10 @@ def init_state(
       - ``"auto"``: pick by stencil family.
 
     ``ensemble > 0`` returns fields with a leading batch axis of that many
-    independently-seeded universes (for the vmapped ensemble stepper).
+    independently-seeded universes (for the vmapped ensemble stepper);
+    ``perturb`` additionally scales each member's inexact fields by
+    ``1 + perturb * u_i`` (:func:`perturb_member`) so members explore a
+    parameter neighborhood, not just different random draws.
     """
     grid_shape = tuple(int(g) for g in grid_shape)
     if len(grid_shape) != stencil.ndim:
@@ -66,7 +100,10 @@ def init_state(
         # batch of independent universes: stack per-member inits (each with
         # its own derived seed) along a leading axis
         members = [
-            init_state(stencil, grid_shape, seed + i, density, kind, periodic)
+            perturb_member(
+                init_state(stencil, grid_shape, seed + i, density, kind,
+                           periodic),
+                stencil, i, seed, perturb, periodic=periodic)
             for i in range(ensemble)
         ]
         return tuple(
@@ -137,6 +174,8 @@ def init_state_sharded(
     density: float = 0.15,
     kind: str = "auto",
     periodic: bool = False,
+    ensemble: int = 0,
+    perturb: float = 0.0,
 ) -> Fields:
     """Initialize fields directly onto their mesh sharding.
 
@@ -146,14 +185,25 @@ def init_state_sharded(
     (BASELINE config 5: 4096^3 fp32 = 256 GiB).  Also the correct
     multi-process path: under multi-host SPMD every process runs this same
     call and owns only its addressable shards.
+
+    ``ensemble > 0``: batched init with the leading member axis sharded
+    over the mesh's ensemble axis when present
+    (``stepper.ensemble_partition_spec``) — each device computes only
+    its own members' blocks; ``perturb`` as in :func:`init_state`.
     """
-    from ..parallel.stepper import grid_partition_spec
+    from ..parallel.stepper import (
+        ensemble_partition_spec,
+        grid_partition_spec,
+    )
     from jax.sharding import NamedSharding
 
-    sharding = NamedSharding(mesh, grid_partition_spec(stencil.ndim, mesh))
+    spec = ensemble_partition_spec(stencil.ndim, mesh) if ensemble else \
+        grid_partition_spec(stencil.ndim, mesh)
+    sharding = NamedSharding(mesh, spec)
 
     def mk():
-        return init_state(stencil, grid_shape, seed, density, kind, periodic)
+        return init_state(stencil, grid_shape, seed, density, kind,
+                          periodic, ensemble=ensemble, perturb=perturb)
 
     out_sh = (sharding,) * stencil.num_fields
     return jax.jit(mk, out_shardings=out_sh)()

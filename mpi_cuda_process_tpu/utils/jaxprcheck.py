@@ -306,6 +306,93 @@ def assert_pipeline_body_structure(
     return report
 
 
+def count_exchange_rounds(closed, exchange: str = "ppermute") -> int:
+    """Exchange equations across all nested jaxprs: ppermutes by
+    default, or (for rdma) the all_gather ring shifts plus the
+    collective pallas_calls nesting a remote dma_start."""
+    return sum(len(_exchange_eqns(jx, exchange))
+               for jx in iter_jaxprs(closed.jaxpr))
+
+
+def assert_ensemble_exchange_invariance(
+    batched_closed,
+    single_closed,
+    exchange: str = "ppermute",
+) -> Dict[str, int]:
+    """The batched ensemble engine's headline structural pin: the
+    exchange-round count of the N-member batched step EQUALS the
+    unbatched step's — independent of N.
+
+    vmap's collective batching rule folds the member axis INTO each
+    ppermute operand (one collective per site, a bigger payload) rather
+    than unrolling one collective per member; an innocent refactor that
+    mapped the exchange per member would keep every value bit-identical
+    while multiplying the per-pass fixed cost by N — exactly the cost
+    the ensemble engine exists to amortize.  Also pins that the batched
+    step gained at least one batched ``pallas_call``-or-update over
+    nothing (a vacuous check against an empty program must fail).
+    """
+    n_batched = count_exchange_rounds(batched_closed, exchange)
+    n_single = count_exchange_rounds(single_closed, exchange)
+    assert n_single > 0, (
+        "the unbatched step contains no exchange — an invariance check "
+        "against an exchange-free program is meaningless")
+    assert n_batched == n_single, (
+        f"batched step issues {n_batched} exchange round(s), the "
+        f"unbatched step {n_single} — the member axis must ride INSIDE "
+        "each collective operand, never unroll into per-member "
+        "exchanges")
+    if exchange == "rdma":
+        assert count_primitive(batched_closed, "ppermute") == 0, (
+            "batched rdma step contains an XLA ppermute — the in-kernel "
+            "exchange must replace every collective-permute at any N")
+    return {"n_exchange_batched": n_batched,
+            "n_exchange_single": n_single}
+
+
+def check_ensemble_structure(
+    stencil_name: str = "heat3d",
+    grid: Tuple[int, int, int] = (32, 16, 128),
+    mesh_shape: Tuple[int, int, int] = (2, 1, 1),
+    k: int = 4,
+    ensemble: int = 4,
+    kind=None,
+    padfree=True,
+    exchange: str = "ppermute",
+) -> Dict[str, object]:
+    """Build the batched and unbatched sharded fused steps and assert
+    exchange-round invariance in N — the entry point
+    ``scripts/check_pipeline_structure.py --ensemble`` (and hence
+    ``scripts/tier1.sh``) drives.  Trace-only: nothing executes.
+    """
+    from .. import make_mesh, make_stencil
+    from ..parallel.stepper import make_sharded_fused_step
+
+    if exchange == "rdma":
+        kind, padfree = "stream", None
+    st = make_stencil(stencil_name)
+    mesh = make_mesh(mesh_shape)
+    mk = lambda ens: make_sharded_fused_step(  # noqa: E731
+        st, mesh, grid, k, interpret=True, kind=kind, padfree=padfree,
+        exchange=exchange, ensemble=ens)
+    batched, single = mk(ensemble), mk(0)
+    assert batched is not None and single is not None, (
+        stencil_name, grid, mesh_shape)
+    assert getattr(batched, "_ensemble", 0) == ensemble
+    single_fields = tuple(
+        jax.ShapeDtypeStruct(tuple(grid), st.dtype)
+        for _ in range(st.num_fields))
+    batched_fields = tuple(
+        jax.ShapeDtypeStruct((ensemble, *grid), st.dtype)
+        for _ in range(st.num_fields))
+    report = assert_ensemble_exchange_invariance(
+        jax.make_jaxpr(batched)(batched_fields),
+        jax.make_jaxpr(single)(single_fields),
+        exchange=exchange)
+    report["ensemble"] = ensemble
+    return report
+
+
 def check_pipeline_structure(
     stencil_name: str = "heat3d",
     grid: Tuple[int, int, int] = (32, 16, 128),

@@ -16,7 +16,12 @@ assertions against the rdma exchange equations, PLUS the zero-ppermute
 gate on the whole step in both build modes — interpret (what tier-1
 executes) and compiled (zero XLA collective anywhere, the exchange
 carried as remote ``dma_start`` eqns inside the collective kernels).
-tier1.sh runs both legs.
+
+``--ensemble N`` runs the batched-engine leg: the N-member batched step
+must issue EXACTLY the unbatched step's exchange-round count (the
+member axis rides inside each collective operand — the fixed-cost
+amortization the ensemble engine exists for), on a z-only and a 2-axis
+mesh, ppermute and rdma transports.  tier1.sh runs all three legs.
 """
 
 import argparse
@@ -51,13 +56,42 @@ _CASES = {
 }
 
 
+_ENSEMBLE_CASES = [
+    dict(stencil_name="heat3d", grid=(32, 16, 128),
+         mesh_shape=(2, 1, 1), k=4, padfree=True),
+    dict(stencil_name="heat3d", grid=(32, 32, 128),
+         mesh_shape=(2, 2, 1), k=4, padfree=True),
+    dict(stencil_name="heat3d", grid=(96, 32, 128),
+         mesh_shape=(2, 1, 1), k=4, exchange="rdma"),
+]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--exchange", default="ppermute",
                     choices=["ppermute", "rdma"],
                     help="which exchange transport's structural "
                          "contract to pin (tier1.sh runs both legs)")
+    ap.add_argument("--ensemble", type=int, default=0, metavar="N",
+                    help="run the batched-engine leg instead: the "
+                         "N-member step's exchange-round count must "
+                         "equal the unbatched step's (both transports, "
+                         "both mesh families)")
     a = ap.parse_args(argv)
+
+    if a.ensemble:
+        from mpi_cuda_process_tpu.utils.jaxprcheck import (
+            check_ensemble_structure,
+        )
+
+        for case in _ENSEMBLE_CASES:
+            rep = check_ensemble_structure(ensemble=a.ensemble, **case)
+            print(f"check_ensemble_structure[{case.get('exchange', 'ppermute')}]"
+                  f": ok {case['mesh_shape']} N={a.ensemble} "
+                  f"(exchange-rounds batched="
+                  f"{rep['n_exchange_batched']} == single="
+                  f"{rep['n_exchange_single']})")
+        return 0
 
     from mpi_cuda_process_tpu.utils.jaxprcheck import (
         check_pipeline_structure,

@@ -113,6 +113,10 @@ def _header_lines(status) -> list:
     ]
     flags = [k for k in ("overlap", "pipeline", "supervise") if run.get(k)]
     extra = []
+    if run.get("ensemble"):
+        em = run.get("ensemble_mesh")
+        extra.append(f"ensemble={run['ensemble']}"
+                     + (f"(x{em} mesh)" if em and em > 1 else ""))
     if run.get("fuse"):
         extra.append(f"fuse={run['fuse']}({run.get('fuse_kind', 'auto')})")
     if run.get("exchange") and run.get("exchange") != "ppermute":
@@ -132,7 +136,12 @@ def _throughput_lines(status) -> list:
     if "steps_per_s" in tp:
         bits.append(f"{tp['steps_per_s']:g} steps/s")
     if "gcells_per_s" in tp:
-        bits.append(f"{tp['gcells_per_s']:g} Gcells/s")
+        label = " Gcells/s (aggregate)" if tp.get("ensemble") else \
+            " Gcells/s"
+        bits.append(f"{tp['gcells_per_s']:g}{label}")
+    if "gcells_per_s_per_member" in tp:
+        bits.append(f"{tp['gcells_per_s_per_member']:g} Gcells/s/member "
+                    f"x{tp['ensemble']}")
     if "steady_ms_per_step_p50" in tp:
         bits.append(f"steady p50 {tp['steady_ms_per_step_p50']:.4g} "
                     f"ms/step (p90 {tp.get('steady_ms_per_step_p90', 0):.4g})")

@@ -28,6 +28,42 @@ python scripts/check_pipeline_structure.py || rc=1
 # step (interpret AND compiled traces), exchange rounds preserved by
 # the slab carry, two-sided interior independence.  Trace-only.
 python scripts/check_pipeline_structure.py --exchange rdma || rc=1
+# The batched-ensemble leg (round 15): the N-member batched step must
+# issue EXACTLY the unbatched step's exchange-round count (the member
+# axis rides inside each collective operand — one exchange round per
+# pass regardless of N), on both mesh families and both transports.
+python scripts/check_pipeline_structure.py --ensemble 4 || rc=1
+# Batched-ensemble smoke: a CPU run with --ensemble 2 on a 2-device
+# mesh must execute the batched sharded stepper end-to-end, emit a
+# schema-valid manifest whose chunk records carry the member count, and
+# report AGGREGATE + per-member throughput in the status payload (a
+# batched run must be distinguishable from a fast single run).
+rm -f /tmp/_t1_ens.jsonl
+timeout -k 10 300 python -c "
+import json
+from cpuforce import force_cpu; force_cpu(8)
+from mpi_cuda_process_tpu import cli
+from mpi_cuda_process_tpu.obs.metrics import RunMetrics
+fields, _ = cli.run(cli.config_from_args(
+    ['--stencil', 'heat3d', '--grid', '32,16,128', '--iters', '8',
+     '--mesh', '2,1,1', '--ensemble', '2', '--log-every', '2',
+     '--telemetry', '/tmp/_t1_ens.jsonl']))
+assert fields[0].shape == (2, 32, 16, 128), fields[0].shape
+rm = RunMetrics()
+recs = [json.loads(l) for l in open('/tmp/_t1_ens.jsonl') if l.strip()]
+for r in recs:
+    rm.ingest(r)
+chunks = [r for r in recs if r.get('kind') == 'chunk']
+assert chunks and all(c.get('members') == 2 for c in chunks), chunks
+tp = rm.status()['throughput']
+assert tp.get('ensemble') == 2 and 'gcells_per_s' in tp \
+    and 'gcells_per_s_per_member' in tp, tp
+assert rm.registry.snapshot()['obs_ensemble_size']['value'] == 2
+print('ensemble smoke ok: %.4f Gcells/s aggregate, %.4f /member' % (
+    tp['gcells_per_s'], tp['gcells_per_s_per_member']))
+" || rc=1
+timeout -k 10 120 python scripts/obs_report.py /tmp/_t1_ens.jsonl --check \
+  > /dev/null || rc=1
 # Interpret-mode rdma smoke: a sharded CLI run with --exchange rdma
 # executes the remote-DMA kernels end-to-end on the CPU backend (the
 # loopback VMEM-ring path, honestly tagged 'interpret-emulated' in the

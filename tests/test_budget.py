@@ -149,20 +149,17 @@ def test_stream_kind_has_no_transient_and_probes_buildability():
     _, parts2 = budget.estimate_run_bytes(
         st, (16, 16, 128), fuse=4, fuse_kind="stream")
     assert any("UNBUILDABLE" in label for label, _ in parts2)
-    # periodic / ensemble: cli.build rejects stream for both (guard-frame,
-    # unbatched only), so the estimate must label the path UNBUILDABLE
-    # rather than describe a kernel the run never takes (round-4 advisor)
+    # periodic: cli.build rejects stream (guard-frame), so the estimate
+    # must label the path UNBUILDABLE rather than describe a kernel the
+    # run never takes (round-4 advisor).  Ensemble runs are BUILDABLE
+    # since round 15 (the batched streaming kernel) — priced, not
+    # walled; pinned in tests/test_ensemble_engine.py.
     _, parts3 = budget.estimate_run_bytes(
         st, (256,) * 3, fuse=4, fuse_kind="stream", periodic=True)
     assert any("UNBUILDABLE" in label for label, _ in parts3)
     _, parts4 = budget.estimate_run_bytes(
         st, (256,) * 3, fuse=4, fuse_kind="stream", ensemble=2)
-    assert any("UNBUILDABLE" in label for label, _ in parts4)
-    # --ensemble 1 is still an ensemble run to cli.build (any truthy
-    # value raises); batch folds 0 and 1 together, so gate on ensemble
-    _, parts5 = budget.estimate_run_bytes(
-        st, (256,) * 3, fuse=4, fuse_kind="stream", ensemble=1)
-    assert any("UNBUILDABLE" in label for label, _ in parts5)
+    assert not any("UNBUILDABLE" in label for label, _ in parts4)
 
 
 def test_config5_stream_envelope_builder_verified():

@@ -234,12 +234,16 @@ def test_ensemble_matches_independent_runs():
             np.asarray(ens[0])[i], np.asarray(solo[0]))
 
 
-def test_ensemble_plus_mesh_rejected():
-    import pytest
-    with pytest.raises(ValueError, match="ensemble"):
-        from mpi_cuda_process_tpu.cli import build
-        build(RunConfig(stencil="life", grid=(16, 16), iters=1,
-                        ensemble=2, mesh=(2, 2)))
+def test_ensemble_plus_mesh_composes():
+    """Round 15 deleted the exclusion wall: --ensemble + --mesh builds
+    the batched sharded stepper (full equivalence coverage lives in
+    tests/test_ensemble_engine.py; this pins that the old raise stays
+    gone)."""
+    from mpi_cuda_process_tpu.cli import build
+    st, step_fn, fields, start = build(
+        RunConfig(stencil="life", grid=(16, 16), iters=1,
+                  ensemble=2, mesh=(2, 2)))
+    assert fields[0].shape == (2, 16, 16)
 
 
 def test_fuse_matches_plain_run():
@@ -431,13 +435,12 @@ def test_config5_rehearsal_reduced_scale():
 def test_fuse_kind_rejects_bad_configs():
     import pytest
 
-    # stream: guard-frame, unbatched, unsharded 3D only
+    # stream: guard-frame only (round 15: --ensemble now batches it —
+    # the "unbatched only" wall is gone, pinned in
+    # tests/test_ensemble_engine.py)
     with pytest.raises(ValueError, match="stream"):
         build(RunConfig(stencil="heat3d", grid=(24, 32, 128), iters=8,
                         fuse=4, fuse_kind="stream", periodic=True))
-    with pytest.raises(ValueError, match="stream"):
-        build(RunConfig(stencil="heat3d", grid=(24, 32, 128), iters=8,
-                        fuse=4, fuse_kind="stream", ensemble=2))
     # sharded stream is allowed ONLY where the builder can host it: a
     # local block too small for the sliding window raises with the
     # constraint list
