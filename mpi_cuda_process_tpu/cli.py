@@ -783,10 +783,22 @@ def _save_ckpt(cfg: RunConfig, fields, step: int):
             cfg.checkpoint_dir, fields, step, dataclasses.asdict(cfg))
 
 
-def _epilogue(cfg: RunConfig, fields, final_step: int, save_ckpt: bool):
+def _session_span(session, name: str, **attrs):
+    """A span on the session's emitter, or a null context without one
+    (spans are never load-bearing — obs/spans.py)."""
+    from .obs import spans as spans_lib
+
+    return spans_lib.maybe_span(
+        getattr(session, "spans", None), name, **attrs)
+
+
+def _epilogue(cfg: RunConfig, fields, final_step: int, save_ckpt: bool,
+              session=None):
     """Shared run tail: final checkpoint + optional ASCII render."""
     if save_ckpt and cfg.checkpoint_dir:
-        _save_ckpt(cfg, fields, final_step)
+        with _session_span(session, "checkpoint", step=final_step,
+                           final=True):
+            _save_ckpt(cfg, fields, final_step)
     if cfg.render:
         print(render.ascii_render(np.asarray(fields[0])))
 
@@ -990,7 +1002,9 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
                          "chunk boundary to scope")
     _check_mem_budget(cfg)
     mesh_lib.bootstrap_distributed()
+    build_t0, build_m0 = time.time(), time.perf_counter()
     st, step_fn, fields, start_step = build(cfg)
+    build_s = time.perf_counter() - build_m0
     if session is not None:
         _emit_static_cost(cfg, st, session)
         if start_step:
@@ -998,6 +1012,13 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
             # its own manifest log (the supervisor mirrors this in its
             # launch events; the ledger carries it into the row detail)
             session.event("resume", resumed_from_step=start_step)
+            if session.spans is not None:
+                # the resume SPAN: the checkpoint restore dominates a
+                # resuming build, so its bracket on the causal timeline
+                # is the build itself, attrs carrying the resume point
+                session.spans.emit("resume", start=build_t0,
+                                   dur_s=build_s,
+                                   resumed_from_step=start_step)
         if cfg.exchange == "rdma":
             # honest mode tag: which execution path actually carries the
             # remote-DMA exchange (the compiled Pallas collective kernel,
@@ -1060,7 +1081,8 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
                            mcells_per_s=round(mcells, 3),
                            converged=bool(res <= cfg.tol),
                            residual=float(res))
-        _epilogue(cfg, fields, start_step + n_done, save_ckpt=True)
+        _epilogue(cfg, fields, start_step + n_done, save_ckpt=True,
+                  session=session)
         return fields, mcells
 
     if cfg.dump_every and cfg.dump_dir:
@@ -1094,7 +1116,8 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
             log.info("step %d  %s", step, diagnostics.format_diagnostics(d))
         if cfg.checkpoint_every and cfg.checkpoint_dir and \
                 step % cfg.checkpoint_every == 0:
-            _save_ckpt(cfg, fs, step)
+            with _session_span(session, "checkpoint", step=step):
+                _save_ckpt(cfg, fs, step)
         if cfg.dump_every and cfg.dump_dir and \
                 step % cfg.dump_every == 0:
             native.async_write_npy(
@@ -1188,7 +1211,8 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
         # must not round to a zero that reads as "no throughput"
         session.finish(steps=remaining, wall_s=round(dt, 4),
                        mcells_per_s=round(mcells, 3))
-    _epilogue(cfg, fields, cfg.iters, save_ckpt=bool(cfg.checkpoint_every))
+    _epilogue(cfg, fields, cfg.iters, save_ckpt=bool(cfg.checkpoint_every),
+              session=session)
     return fields, mcells
 
 

@@ -65,6 +65,18 @@ class RunHandle:
         self.sim_signature = sim_signature(config)
         self.telemetry_path = telemetry_path
         self.submitted_at = time.time()
+        # request-span identity (obs/spans.py): the engine opens a
+        # "request" span per submission; the run's own session inherits
+        # this context (thread-local propagation), so every span the run
+        # emits — compile, checkpoint, chunks' compile — parents under
+        # the request on ONE trace
+        self.trace_id: Optional[str] = None
+        self.request_span_id: Optional[str] = None
+        self.started_at: Optional[float] = None   # run lock acquired
+        self.finished_at: Optional[float] = None
+        # queue_wait_s / time_to_first_chunk_s / latency_s, filled by
+        # the engine's post-run accounting
+        self.timings: Dict[str, Optional[float]] = {}
         self._done = threading.Event()
         self._result: Optional[Tuple] = None
         self._error: Optional[BaseException] = None
@@ -128,7 +140,7 @@ class RunHandle:
             rec.pop("_seq", None)
             rm.ingest(rec)
         out = rm.status()
-        out["request"] = {
+        req: Dict[str, Any] = {
             "id": self.id,
             "submitted_at": self.submitted_at,
             "telemetry": self.telemetry_path,
@@ -136,6 +148,16 @@ class RunHandle:
             "phase": ("failed" if self._error is not None else
                       "done" if self._done.is_set() else "running"),
         }
+        if self.trace_id is not None:
+            req["trace_id"] = self.trace_id
+        if self.started_at is not None:
+            # live queue accounting: how long this request waited for
+            # the mesh before its run began
+            req["queue_wait_s"] = round(
+                self.started_at - self.submitted_at, 6)
+        req.update({k: v for k, v in self.timings.items()
+                    if v is not None})
+        out["request"] = req
         return out
 
 
@@ -154,11 +176,17 @@ class SimulationEngine:
 
     def __init__(self, telemetry_dir: Optional[str] = None):
         from .obs import trace as trace_lib
+        from .obs.metrics import MetricsRegistry
 
         self.telemetry_dir = telemetry_dir or \
             trace_lib.default_telemetry_dir()
         self._run_lock = threading.Lock()
         self._handles: List[RunHandle] = []
+        # engine-level request metrics: per-request latency histograms
+        # (queue wait, time-to-first-chunk, end-to-end) — the numbers
+        # the ROADMAP item-1 scheduler's admission control will read;
+        # rendered by ``self.metrics.to_prometheus()``
+        self.metrics = MetricsRegistry()
 
     # -- submission -----------------------------------------------------
 
@@ -200,8 +228,17 @@ class SimulationEngine:
                 "own supervision tree — launch supervised runs through "
                 "the CLI")
         cfg = self._prepare(cfg)
+        from .obs import spans as spans_lib
+
         handle = RunHandle(f"run-{os.getpid()}-{next(self._ids)}", cfg,
                            cfg.telemetry)
+        # the request span opens at submit: the engine owns the trace
+        # root of this request unless it was itself called under one
+        # (a traced caller's context chains through)
+        inherited = spans_lib.resolve_context()
+        handle.trace_id = inherited.trace_id if inherited \
+            else spans_lib.new_id()
+        handle.request_span_id = spans_lib.new_id()
         self._handles.append(handle)
         t = threading.Thread(target=self._execute, args=(handle,),
                              name=f"sim-engine-{handle.id}", daemon=True)
@@ -211,14 +248,91 @@ class SimulationEngine:
 
     def _execute(self, handle: RunHandle) -> None:
         from . import cli
+        from .obs import spans as spans_lib
 
         with self._run_lock:
+            handle.started_at = time.time()
+            # in-process trace propagation: the run's session (opened
+            # inside cli.run on THIS thread) adopts the request context
+            spans_lib.push_thread_context(spans_lib.SpanContext(
+                handle.trace_id, handle.request_span_id))
             try:
                 handle._result = cli.run(handle.config)
             except BaseException as e:  # noqa: BLE001 — delivered via
                 handle._error = e       # handle.result(), never lost
             finally:
+                spans_lib.pop_thread_context()
+                handle.finished_at = time.time()
+                try:
+                    self._account(handle)
+                except Exception:  # noqa: BLE001 — accounting is
+                    pass           # telemetry, never load-bearing
                 handle._done.set()
+
+    def _account(self, handle: RunHandle) -> None:
+        """Post-run request accounting: latency histograms + the
+        request span tree appended to the (now closed) telemetry log —
+        queue-wait -> compile/chunks (the run's own spans/events) ->
+        result, all under one trace_id."""
+        from .obs import spans as spans_lib
+
+        sub, start = handle.submitted_at, handle.started_at
+        end = handle.finished_at or time.time()
+        queue_wait = (start - sub) if start is not None else None
+        latency = end - sub
+        chunks = [r for r in handle.events()
+                  if r.get("kind") == "chunk"
+                  and isinstance(r.get("t"), (int, float))]
+        first_chunk_t = chunks[0]["t"] if chunks else None
+        last_chunk_t = chunks[-1]["t"] if chunks else None
+        ttfc = (first_chunk_t - sub) if first_chunk_t is not None else None
+        handle.timings = {
+            "queue_wait_s": round(queue_wait, 6)
+            if queue_wait is not None else None,
+            "time_to_first_chunk_s": round(ttfc, 6)
+            if ttfc is not None else None,
+            "latency_s": round(latency, 6),
+        }
+        with self.metrics.lock:
+            self.metrics.counter(
+                "engine_requests_total", "submitted runs completed").inc()
+            if handle._error is not None:
+                self.metrics.counter("engine_requests_failed_total",
+                                     "submitted runs that raised").inc()
+            if queue_wait is not None:
+                self.metrics.histogram(
+                    "engine_queue_wait_s",
+                    "submit -> run-lock acquired").observe(queue_wait)
+            if ttfc is not None:
+                self.metrics.histogram(
+                    "engine_time_to_first_chunk_s",
+                    "submit -> first completed chunk (the serving "
+                    "SLO)").observe(ttfc)
+            self.metrics.histogram(
+                "engine_request_latency_s",
+                "submit -> result end-to-end").observe(latency)
+        # the request span tree, appended to the closed log so the
+        # per-request timeline lives next to the run's own spans
+        tid, rid = handle.trace_id, handle.request_span_id
+        if not tid or not rid:
+            return
+        recs = []
+        if queue_wait is not None:
+            recs.append(spans_lib.make_span_record(
+                "queue_wait", tid, spans_lib.new_id(), rid,
+                sub, queue_wait))
+        if last_chunk_t is not None and end >= last_chunk_t:
+            recs.append(spans_lib.make_span_record(
+                "result", tid, spans_lib.new_id(), rid,
+                last_chunk_t, end - last_chunk_t))
+        recs.append(spans_lib.make_span_record(
+            "request", tid, rid, None, sub, latency,
+            attrs={"id": handle.id,
+                   "ok": handle._error is None,
+                   "queue_wait_s": handle.timings["queue_wait_s"],
+                   "time_to_first_chunk_s":
+                       handle.timings["time_to_first_chunk_s"]}))
+        spans_lib.append_span_records(handle.telemetry_path, recs)
 
     # -- introspection --------------------------------------------------
 
@@ -239,4 +353,5 @@ class SimulationEngine:
                 "submitted_at": h.submitted_at,
             })
         return {"handles": rows, "pending": sum(
-            1 for h in self._handles if not h.done())}
+            1 for h in self._handles if not h.done()),
+            "metrics": self.metrics.snapshot()}

@@ -13,6 +13,9 @@ validator is the single definition) and the same event vocabulary:
   (``profile.py``: measured overlap efficiency, or an explicit
   ``attribution: unavailable`` — never fabricated zeros)
 * ``label`` / ``rung`` — benchmark-harness progress records
+* ``span``       — one finished span of the causal timeline
+  (``spans.py``: trace_id/span_id/parent_id + wall start + duration;
+  the root span closes every log)
 * ``error`` / ``summary`` — how the run ended
 
 Sibling stores complete the layer: ``profile.py`` wraps a
@@ -41,6 +44,7 @@ from typing import Any, Dict, Optional
 
 from . import heartbeat as heartbeat_lib
 from . import runtime as runtime_lib
+from . import spans as spans_lib
 from . import trace as trace_lib
 
 
@@ -49,17 +53,23 @@ class Session:
 
     ``recorder`` is the driver-facing observer
     (``record_chunk(steps, seconds)`` at chunk boundaries);
-    ``event``/``finish``/``error`` write to the trace.  ``finish`` and
+    ``event``/``finish``/``error`` write to the trace.  ``spans`` is
+    the session's :class:`~.spans.SpanEmitter` (one causal timeline:
+    the trace context is inherited from ``OBS_TRACE_CONTEXT`` — or the
+    spawning thread — when a parent exported one).  ``finish`` and
     ``close`` are idempotent, and ``close`` always stops the heartbeat
-    first so no verdict thread outlives its run.
+    first so no verdict thread outlives its run, then emits the root
+    span before the trace writer closes.
     """
 
     def __init__(self, trace: trace_lib.TraceWriter,
                  recorder: runtime_lib.RuntimeRecorder,
-                 heartbeat: Optional[heartbeat_lib.Heartbeat]):
+                 heartbeat: Optional[heartbeat_lib.Heartbeat],
+                 spans: Optional[spans_lib.SpanEmitter] = None):
         self.trace = trace
         self.recorder = recorder
         self.heartbeat = heartbeat
+        self.spans = spans
         self._finished = False
 
     @property
@@ -95,6 +105,8 @@ class Session:
     def close(self) -> None:
         if self.heartbeat is not None:
             self.heartbeat.stop()
+        if self.spans is not None:
+            self.spans.close()  # root span: before the writer closes
         self.trace.close()
 
     def __enter__(self) -> "Session":
@@ -122,18 +134,25 @@ def open_session(
 
     The shared constructor all four tools call — the mechanism by which
     "same schema" is a property of the code rather than a convention.
+    The session's span emitter adopts an inherited ``OBS_TRACE_CONTEXT``
+    (or the spawning thread's pending context) so a supervised child's
+    — or an engine request's — spans share the parent's trace_id; the
+    manifest carries the ``trace`` identity block either way.
     """
     trace = trace_lib.TraceWriter(path)
+    spans = spans_lib.SpanEmitter(trace, context=spans_lib.resolve_context(),
+                                  root_name=tool)
+    manifest_extra.setdefault("trace", spans.manifest_block())
     trace.write_manifest(trace_lib.build_manifest(
         tool, run, **manifest_extra))
     recorder = runtime_lib.RuntimeRecorder(trace=trace, step_unit=step_unit,
-                                           ensemble=ensemble)
+                                           ensemble=ensemble, spans=spans)
     hb = None
     if with_heartbeat:
         hb = heartbeat_lib.Heartbeat(recorder, trace=trace,
                                      stall_after_s=stall_after_s)
         hb.start()
-    return Session(trace, recorder, hb)
+    return Session(trace, recorder, hb, spans=spans)
 
 
 __all__ = ["Session", "open_session"]

@@ -154,6 +154,36 @@ class Info:
         return {"kind": self.kind, "labels": dict(self.labels)}
 
 
+class GaugeFamily:
+    """Labeled gauge family: one value per label set, rendered as one
+    Prometheus line each (``name{label="..",backend=".."} v``) — the
+    shape the ledger's ``best_known`` table exports as (label x backend
+    baselines on ``/metrics``, so the live console and the ledger stop
+    being separate surfaces)."""
+
+    kind = "gauge_family"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.values: "collections.OrderedDict[Tuple, Tuple[Dict[str, Any], float]]" = \
+            collections.OrderedDict()
+
+    def set(self, value: float, **labels: Any) -> None:
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        self.values[key] = (dict(labels), float(value))
+
+    def render(self) -> List[str]:
+        name = _prom_name(self.name)
+        return [f"{name}{_prom_labels(labels)} {_prom_value(v)}"
+                for labels, v in self.values.values()]
+
+    def snap(self) -> Dict[str, Any]:
+        return {"kind": self.kind,
+                "values": [{"labels": dict(labels), "value": v}
+                           for labels, v in self.values.values()]}
+
+
 class Histogram:
     """Bounded-reservoir histogram: newest ``bound`` observations.
 
@@ -210,7 +240,7 @@ class Histogram:
 # gauge; the bounded-reservoir histogram renders as a summary — it
 # exposes quantiles, not cumulative buckets).
 _PROM_TYPE = {"counter": "counter", "gauge": "gauge", "info": "gauge",
-              "histogram": "summary"}
+              "gauge_family": "gauge", "histogram": "summary"}
 
 
 class MetricsRegistry:
@@ -247,6 +277,9 @@ class MetricsRegistry:
 
     def info(self, name: str, help: str = "") -> Info:
         return self._get(Info, name, help)
+
+    def gauge_family(self, name: str, help: str = "") -> GaugeFamily:
+        return self._get(GaugeFamily, name, help)
 
     def histogram(self, name: str, help: str = "",
                   bound: int = 512) -> Histogram:
@@ -324,6 +357,11 @@ class RunMetrics:
             collections.deque(maxlen=max_errors)
         self._cells: Optional[int] = None
         self._members: int = 0  # ensemble size (0 = unbatched run)
+        # span tracing (round 16): the trace identity this stream
+        # belongs to, and the serving-side request latency accounting
+        self.trace_id: Optional[str] = None
+        self.spans_seen = 0
+        self.time_to_first_chunk_s: Optional[float] = None
 
     # -- ingestion ------------------------------------------------------
 
@@ -354,11 +392,20 @@ class RunMetrics:
         if handler is not None:
             handler(rec)
 
+    def _set_trace_id(self, trace_id: Any) -> None:
+        if self.trace_id is None and isinstance(trace_id, str) and trace_id:
+            self.trace_id = trace_id
+            self.registry.info(
+                "obs_trace_info",
+                "causal trace identity this stream belongs to").set(
+                trace_id=trace_id)
+
     def _on_manifest(self, rec: Dict[str, Any]) -> None:
         self.manifests_seen += 1
         self.registry.counter(
             "obs_manifests_total",
             "manifests seen (supervised runs: 1 + one per attempt)").inc()
+        self._set_trace_id((rec.get("trace") or {}).get("trace_id"))
         if self.manifest is not None:
             return
         self.manifest = rec
@@ -406,6 +453,21 @@ class RunMetrics:
             self.registry.gauge(
                 "obs_first_chunk_ms_per_step",
                 "compile+warmup chunk ms/step").set(ms)
+        if first and self.time_to_first_chunk_s is None \
+                and self.manifest is not None:
+            # request-latency accounting (round 16): wall seconds from
+            # the stream's FIRST manifest (the run/request open) to the
+            # first completed chunk — the serving-engine SLO number
+            created = (self.manifest or {}).get("created_at")
+            t_end = rec.get("t")
+            if isinstance(created, (int, float)) and \
+                    isinstance(t_end, (int, float)) and t_end >= created:
+                self.time_to_first_chunk_s = round(t_end - created, 6)
+                self.registry.gauge(
+                    "obs_time_to_first_chunk_s",
+                    "seconds from run open to the first completed "
+                    "chunk (compile + warmup + first results)").set(
+                    self.time_to_first_chunk_s)
         if not first and ms is not None and not rec.get("recompiled"):
             self.registry.histogram(
                 "obs_chunk_ms_per_step",
@@ -529,6 +591,23 @@ class RunMetrics:
             "obs_campaign_label_events_total",
             "campaign label progress events").inc()
 
+    def _on_span(self, rec: Dict[str, Any]) -> None:
+        """Fold one finished span: per-name duration histograms (the
+        ``request`` spans of the engine become the per-request latency
+        histogram on ``/metrics``) + the trace identity."""
+        self.spans_seen += 1
+        self.registry.counter("obs_spans_total",
+                              "finished spans ingested").inc()
+        self._set_trace_id(rec.get("trace_id"))
+        name = rec.get("name")
+        dur = rec.get("dur_s")
+        if isinstance(name, str) and name and \
+                isinstance(dur, (int, float)):
+            safe = _prom_name(name)[:48]
+            self.registry.histogram(
+                f"obs_span_{safe}_seconds",
+                f"duration of '{name}' spans").observe(dur)
+
     def _on_error(self, rec: Dict[str, Any]) -> None:
         self.errors.append(rec)
         self.registry.counter("obs_errors_total", "error events").inc()
@@ -620,6 +699,12 @@ class RunMetrics:
                 "summary": self.summary,
                 "errors": list(self.errors),
             }
+            if self.trace_id is not None:
+                out["trace_id"] = self.trace_id
+            if self.time_to_first_chunk_s is not None:
+                out["time_to_first_chunk_s"] = self.time_to_first_chunk_s
+            if self.spans_seen:
+                out["spans_seen"] = self.spans_seen
             roof = (self.costmodel or {}).get("roofline")
             if roof:
                 out["roofline"] = roof

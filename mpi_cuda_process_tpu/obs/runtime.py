@@ -99,9 +99,15 @@ class RuntimeRecorder:
     """
 
     def __init__(self, trace=None, step_unit: int = 1, profiler=None,
-                 ensemble: int = 0):
+                 ensemble: int = 0, spans=None):
         self.trace = trace
         self.profiler = profiler
+        # span emitter (obs/spans.py, optional): chunk 0 — the compile +
+        # warmup chunk — is emitted as a "compile" span so the causal
+        # timeline names the compile explicitly; steady chunks stay
+        # events only (the exporter derives their slices from t/wall_s,
+        # no event-volume doubling)
+        self.spans = spans
         self.step_unit = max(1, int(step_unit))
         # batched runs: member count stamped on every chunk record so a
         # batched run is distinguishable from a fast single run in the
@@ -169,6 +175,10 @@ class RuntimeRecorder:
         self.chunks.append(rec)
         if self.trace is not None:
             self.trace.event("chunk", **rec)
+        if n == 0 and self.spans is not None:
+            self.spans.emit("compile", time.time() - float(seconds),
+                            float(seconds), steps=real_steps,
+                            ms_per_step=rec["ms_per_step"])
         return rec
 
     def summary(self) -> Dict[str, Any]:

@@ -103,19 +103,60 @@ class RunConsole:
         """Drain all tails once; returns the number of new records."""
         with self._cond:
             tails = list(self._tails)
-        new: List[Dict[str, Any]] = []
-        for _path, tail in tails:
-            new.extend(tail.poll())
+        new: List[Tuple[str, Dict[str, Any]]] = []
+        for path, tail in tails:
+            new.extend((path, rec) for rec in tail.poll())
         if not new:
             return 0
-        for rec in new:
-            self.metrics.ingest(rec)
+        for path, rec in new:
+            self._ingest(path, rec)
         with self._cond:
-            for rec in new:
+            for _path, rec in new:
                 self.seq += 1
                 self._events.append((self.seq, rec))
             self._cond.notify_all()
         return len(new)
+
+    def _ingest(self, path: str, rec: Dict[str, Any]) -> None:
+        """Per-record hook (source path attached): the base console
+        folds everything into ONE merged RunMetrics; the aggregate
+        console (obs/aggregate.py) also routes by origin so the
+        per-host table stays separable."""
+        self.metrics.ingest(rec)
+
+    def status(self) -> Dict[str, Any]:
+        """The ``/status.json`` payload (subclasses extend it — the
+        aggregate console adds the per-host table)."""
+        return self.metrics.status()
+
+    def load_ledger(self, path: Optional[str] = None) -> int:
+        """Fold the campaign ledger's ``best_known`` baselines into the
+        registry as labeled gauges (``obs_ledger_best_known{label,
+        backend}``) so ``/metrics`` carries the cross-round table next
+        to the live numbers.  Best-effort; returns baselines loaded."""
+        from . import ledger as ledger_lib
+
+        path = path or ledger_lib.default_ledger_path()
+        try:
+            best = ledger_lib.best_known(ledger_lib.read_rows(path))
+        except Exception:  # noqa: BLE001 — the console serves without it
+            return 0
+        reg = self.metrics.registry
+        with reg.lock:
+            fam = reg.gauge_family(
+                "obs_ledger_best_known",
+                "campaign-ledger best known value per label x backend "
+                "(quarantined rows structurally excluded)")
+            for row in best.values():
+                key = row.get("key") or {}
+                try:
+                    fam.set(float(row["value"]),
+                            label=key.get("label"),
+                            backend=key.get("backend"),
+                            unit=row.get("unit"))
+                except (TypeError, ValueError, KeyError):
+                    continue
+        return len(best)
 
     def events_after(self, after: int, limit: int = 1000,
                      wait_s: float = 0.0) -> List[Tuple[int, Dict[str, Any]]]:
@@ -201,8 +242,7 @@ class _Handler(BaseHTTPRequestHandler):
                             self.console.metrics.registry.to_prometheus(),
                             "text/plain; version=0.0.4; charset=utf-8")
             elif route in ("/status.json", "/status"):
-                body = json.dumps(self.console.metrics.status(),
-                                  default=str)
+                body = json.dumps(self.console.status(), default=str)
                 self._reply(200, body, "application/json")
             elif route == "/events":
                 self._events(url)
@@ -303,6 +343,18 @@ class ObsServer:
         self.close()
 
 
+def _ledger_if_present(console: RunConsole) -> None:
+    """Best-known baselines ride every served console when the default
+    ledger exists (the ledger and the live console are ONE surface)."""
+    from . import ledger as ledger_lib
+
+    try:
+        if os.path.exists(ledger_lib.default_ledger_path()):
+            console.load_ledger()
+    except Exception:  # noqa: BLE001 — never load-bearing
+        pass
+
+
 def serve_run(log_path: str, port: int = 0, host: str = "127.0.0.1",
               poll_s: float = 0.25,
               extra_logs: Optional[List[str]] = None) -> ObsServer:
@@ -311,11 +363,27 @@ def serve_run(log_path: str, port: int = 0, host: str = "127.0.0.1",
     console.watch(log_path)
     for p in extra_logs or ():
         console.watch(p)
+    _ledger_if_present(console)
     return ObsServer(console, port=port, host=host, poll_s=poll_s)
 
 
 def serve_campaign(directory: str, port: int = 0, host: str = "127.0.0.1",
                    poll_s: float = 0.5) -> ObsServer:
     """Serve a directory of manifests (the campaign aggregator)."""
-    return ObsServer(CampaignConsole(directory), port=port, host=host,
-                     poll_s=poll_s)
+    console = CampaignConsole(directory)
+    _ledger_if_present(console)
+    return ObsServer(console, port=port, host=host, poll_s=poll_s)
+
+
+def serve_aggregate(paths: List[str], port: int = 0,
+                    host: str = "127.0.0.1",
+                    poll_s: float = 0.25) -> ObsServer:
+    """Serve N per-process telemetry logs as ONE status page: the
+    merged stream on /metrics and /events, plus the per-host table
+    (``hosts``/``aggregate``) on /status.json — the multi-host roll-up
+    of ROADMAP item 5 (obs/aggregate.py)."""
+    from . import aggregate as aggregate_lib
+
+    console = aggregate_lib.make_console(paths)
+    _ledger_if_present(console)
+    return ObsServer(console, port=port, host=host, poll_s=poll_s)

@@ -344,11 +344,22 @@ class LogTail:
     started, or dead before its first event) yields no records rather
     than raising; malformed complete lines are counted and skipped —
     the watcher must survive anything a dying process leaves behind.
+
+    Truncation/rotation is detected by size: a file now SHORTER than
+    the consumed offset was rewritten from the top (a supervisor
+    restart reuses the telemetry path — TraceWriter opens ``"w"`` — or
+    a log rotation swapped the inode), so the tail restarts at byte 0
+    instead of sticking forever past the new EOF.  ``truncations``
+    counts the resets.  A rewrite that has already grown PAST the old
+    offset is indistinguishable from an append by size alone and is
+    not detected — every writer in this repo starts a fresh file
+    empty, so the shrink is observable at the next poll.
     """
 
     def __init__(self, path: str):
         self.path = path
         self.malformed = 0
+        self.truncations = 0
         self._pos = 0
 
     def poll(self) -> List[Dict[str, Any]]:
@@ -357,6 +368,13 @@ class LogTail:
         except OSError:
             return []
         with fh:
+            try:
+                size = os.fstat(fh.fileno()).st_size
+            except OSError:
+                size = None
+            if size is not None and size < self._pos:
+                self._pos = 0
+                self.truncations += 1
             fh.seek(self._pos)
             buf = fh.read()
         end = buf.rfind(b"\n")

@@ -42,6 +42,7 @@ with nothing to resume, so a wedge there costs the in-flight *attempt*
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
@@ -277,6 +278,18 @@ def supervise(launcher, checkpoint_dir: Optional[str], *,
             except Exception:  # noqa: BLE001 — telemetry never load-bearing
                 pass
 
+    # span emitter (obs/spans.py): the supervisor owns the RUN-LEVEL
+    # trace — every attempt is an "attempt" span, every kill/backoff a
+    # span between them, and the launcher exports OBS_TRACE_CONTEXT (via
+    # spans.env_extra, called INSIDE the attempt span) so each child's
+    # own spans join this one trace under its attempt.
+    spans = getattr(session, "spans", None)
+
+    def _span(name: str, **attrs: Any):
+        if spans is not None:
+            return spans.span(name, **attrs)
+        return contextlib.nullcontext()
+
     restarts: List[Dict[str, Any]] = []
     resumed_from: Optional[int] = None
     for attempt in range(max_restarts + 1):
@@ -285,10 +298,21 @@ def supervise(launcher, checkpoint_dir: Optional[str], *,
         resumed_from = step if resume else None
         _event("launch", attempt=attempt, resume=resume,
                resumed_from_step=resumed_from)
-        handle, tails = launcher(attempt, resume)
-        outcome, value, detail = watch_child(
-            handle, tails, stall_timeout_s=stall_timeout_s, poll_s=poll_s,
-            kill_verdicts=kill_verdicts, clock=clock, sleep=sleep)
+        with _span("attempt", attempt=attempt, resume=resume,
+                   resumed_from_step=resumed_from):
+            handle, tails = launcher(attempt, resume)
+            outcome, value, detail = watch_child(
+                handle, tails, stall_timeout_s=stall_timeout_s,
+                poll_s=poll_s, kill_verdicts=kill_verdicts, clock=clock,
+                sleep=sleep)
+            if outcome != "exit":
+                # verdict/stall: the child is alive but lost — kill the
+                # whole group and reap it so the relaunch never races a
+                # half-dead predecessor for the checkpoint dir
+                with _span("kill", attempt=attempt, reason=outcome,
+                           verdict=value if outcome == "verdict" else None):
+                    handle.kill()
+                    handle.wait()
         if outcome == "exit" and value == 0:
             _event("summary", ok=True, attempts=attempt + 1,
                    restarts=len(restarts), resumed_from_step=resumed_from)
@@ -297,12 +321,6 @@ def supervise(launcher, checkpoint_dir: Optional[str], *,
                 gave_up=False, final_rc=0, resumed_from_step=resumed_from,
                 checkpoint_dir=checkpoint_dir,
                 telemetry=getattr(session, "path", None))
-        if outcome != "exit":
-            # verdict/stall: the child is alive but lost — kill the
-            # whole group and reap it so the relaunch never races a
-            # half-dead predecessor for the checkpoint dir
-            handle.kill()
-            handle.wait()
         reason = {"exit": f"child exited rc={value}",
                   "verdict": f"heartbeat verdict {value}",
                   "stall": "wall-clock stall"}[outcome]
@@ -323,7 +341,15 @@ def supervise(launcher, checkpoint_dir: Optional[str], *,
                "checkpoint_step": latest_checkpoint_step(checkpoint_dir)}
         restarts.append(rec)
         _event("restart", **rec)
-        sleep(wait)
+        # the restart span sits causally BETWEEN the two attempt spans
+        # and names the step the next attempt will resume from — the
+        # one-line answer to "what did the restart cost, and from where
+        # did we come back?" on the exported timeline
+        with _span("restart", attempt=attempt, reason=reason,
+                   backoff_s=wait,
+                   resumed_from_step=rec["checkpoint_step"]):
+            with _span("backoff", backoff_s=wait):
+                sleep(wait)
     raise AssertionError("unreachable: the loop returns on every path")
 
 
@@ -349,6 +375,7 @@ def run_supervised(cfg) -> int:
     import logging
 
     from ..config import to_argv
+    from ..obs import spans as spans_lib
     from ..obs import trace as trace_lib
 
     log = logging.getLogger("mpi_cuda_process_tpu.supervisor")
@@ -387,10 +414,15 @@ def run_supervised(cfg) -> int:
     server = None
     if cfg.serve_port is not None:
         try:
+            from ..obs import aggregate as aggregate_lib
             from ..obs import serve as serve_lib
 
-            console = serve_lib.RunConsole()
-            console.watch(sibling_path(telemetry_base, "supervisor"))
+            # the aggregate console (round 16): /status.json carries a
+            # per-host/process table next to the merged stream, so one
+            # address answers for supervisor + every attempt (and, once
+            # the multi-host launch path lands, every host's log)
+            console = aggregate_lib.make_console(
+                [sibling_path(telemetry_base, "supervisor")])
             server = serve_lib.ObsServer(console, port=cfg.serve_port)
             log.info("supervisor obs console serving at %s", server.url)
             if session is not None:
@@ -415,9 +447,13 @@ def run_supervised(cfg) -> int:
             # the console follows the child across restarts: each
             # attempt's log joins the merged stream before the spawn
             server.console.watch(tel)
+        # cross-process trace propagation (obs/spans.py): the launcher
+        # runs inside supervise()'s "attempt" span, so the exported
+        # OBS_TRACE_CONTEXT parents the child's whole span tree under
+        # this attempt — one trace_id across supervisor and every child
         handle = spawn_child(
             [sys.executable, "-m", "mpi_cuda_process_tpu", *argv],
-            attempt=attempt)
+            attempt=attempt, env_extra=spans_lib.env_extra(session))
         return handle, [trace_lib.LogTail(tel)]
 
     try:

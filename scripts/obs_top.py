@@ -229,10 +229,37 @@ def _campaign_lines(status, ledger_path) -> list:
                           "best_known", "delta", "error"])]
 
 
+def _hosts_lines(status) -> list:
+    """Per-host/process table (obs/aggregate.py roll-up, when served)."""
+    hosts = status.get("hosts")
+    if not hosts:
+        return []
+    agg = status.get("aggregate") or {}
+    rows = []
+    for r in hosts:
+        tp = r.get("throughput") or {}
+        chunk = r.get("latest_chunk") or {}
+        rows.append([
+            f"{r.get('hostname', '?')} p{r.get('process_index', '?')}",
+            r.get("verdict") or "-",
+            chunk.get("chunk") if chunk else "-",
+            tp.get("gcells_per_s", "-"),
+            r.get("restarts") or 0,
+            r.get("time_to_first_chunk_s", "-"),
+            str(r.get("trace_id") or "-")[:12]])
+    head = (f"hosts ({agg.get('processes', len(rows))} processes on "
+            f"{agg.get('hosts', '?')} host(s): "
+            f"verdict={agg.get('verdict', '?')}  "
+            f"{agg.get('gcells_per_s', 0)} Gcells/s aggregate)")
+    return [head, _table(rows, ["host", "verdict", "chunk", "Gcells/s",
+                                "restarts", "ttfc_s", "trace"])]
+
+
 def run_frame(status, ledger_path) -> str:
     lines = _header_lines(status)
     lines += _throughput_lines(status)
     lines += _health_lines(status)
+    lines += _hosts_lines(status)
     lines += _campaign_lines(status, ledger_path)
     return "\n".join(lines)
 
@@ -291,12 +318,32 @@ def _is_ledger(path: str) -> bool:
         return False
 
 
-def frame(source: str, ledger_path: str) -> str:
+def frame(source: str, ledger_path: str):
+    """One rendered frame: ``(text, status-or-None)`` — the status dict
+    rides along so ``--once`` can turn health into an exit code
+    (ledger frames have no run health; status is None)."""
     if source.startswith(("http://", "https://")):
-        return run_frame(_status_from_url(source), ledger_path)
+        status = _status_from_url(source)
+        return run_frame(status, ledger_path), status
     if _is_ledger(source):
-        return ledger_frame(source)
-    return run_frame(_status_from_log(source), ledger_path)
+        return ledger_frame(source), None
+    status = _status_from_log(source)
+    return run_frame(status, ledger_path), status
+
+
+def health_rc(status) -> int:
+    """CI/campaign health probe verdict for ``--once``: nonzero when
+    the latest heartbeat verdict is WEDGED/STALLED, the supervisor gave
+    up, or — on an aggregate page — ANY host is in one of those states."""
+    if not status:
+        return 0
+    bad = ("WEDGED", "STALLED", "GAVE_UP")
+    if status.get("verdict") in bad or status.get("give_up"):
+        return 1
+    agg = status.get("aggregate") or {}
+    if agg.get("verdict") in bad:
+        return 1
+    return 0
 
 
 def main(argv=None) -> int:
@@ -307,18 +354,23 @@ def main(argv=None) -> int:
     ap.add_argument("--interval", type=float, default=2.0,
                     help="refresh seconds (default 2)")
     ap.add_argument("--once", action="store_true",
-                    help="render one frame and exit (no clear, no loop)")
+                    help="render one frame and exit (no clear, no "
+                         "loop); the exit code is a health probe — "
+                         "nonzero on a WEDGED/STALLED verdict or a "
+                         "supervisor give-up, so CI and campaign "
+                         "scripts can gate on it")
     ap.add_argument("--ledger", default=None,
                     help="ledger path for campaign best_known deltas "
                          f"(default {ledger_lib.default_ledger_path()})")
     a = ap.parse_args(argv)
     ledger_path = a.ledger or ledger_lib.default_ledger_path()
     if a.once:
-        print(frame(a.source, ledger_path))
-        return 0
+        body, status = frame(a.source, ledger_path)
+        print(body)
+        return health_rc(status)
     try:
         while True:
-            body = frame(a.source, ledger_path)
+            body, _status = frame(a.source, ledger_path)
             sys.stdout.write("\x1b[2J\x1b[H" + body + "\n")
             sys.stdout.flush()
             time.sleep(a.interval)

@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""Export telemetry JSONL log(s) into ONE Chrome-trace/Perfetto JSON.
+
+The post-hoc face of the span layer (``obs/spans.py``): any run — a
+single CLI run, a supervised run with restarts (supervisor log + one
+log per attempt), or N per-host logs of a multi-host run — renders as
+a single causal timeline loadable in ``chrome://tracing`` or
+https://ui.perfetto.dev.  Tracks are **hosts/processes** (one Chrome
+"process" per schema-2 ``hostname`` x ``process_index``, one "thread"
+per source log: ``supervisor``, ``attempt0``, ``attempt1``, ...);
+slices are the span vocabulary — ``compile``, ``chunk``,
+``checkpoint``, ``kill``, ``backoff``, ``restart``, ``resume``,
+``attempt``, ``request`` — drawn from span records where the log has
+them and synthesized from ``chunk`` events (``t`` − ``wall_s``)
+everywhere, so pre-span logs still export.  Instant markers carry the
+point events: heartbeat verdicts, launches, errors, give-up, exchange
+mode.
+
+Every exported slice keeps its ``trace_id``/``span_id``/``parent_id``
+in ``args``, so "do the supervisor and both attempts share one trace?"
+is a one-liner over the output (the tier-1 span smoke asserts exactly
+that).  The export is self-validating: :func:`validate_export` runs on
+the built object before anything is written, and a schema problem is a
+nonzero exit, not a silently broken JSON.
+
+Usage::
+
+    python scripts/obs_trace_export.py PATH [PATH...] [-o OUT.json]
+
+``PATH`` may be a telemetry JSONL file, a directory (every ``*.jsonl``
+inside), or a supervised run's base path — ``run.jsonl`` expands to
+every ``run.*.jsonl`` sibling (``.supervisor`` + ``.attemptN``), which
+is how a supervised run that never wrote the base file itself is named
+by one argument.  Safe on a wedged box: no jax import anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# instant-marker mapping: obs event kind -> slice name builder
+_INSTANT_KINDS = ("heartbeat", "launch", "give_up", "error", "abort",
+                  "resume", "exchange", "serve", "summary", "restart")
+
+
+def discover(arg: str) -> List[str]:
+    """Expand one CLI argument into concrete log paths (see module
+    docstring).  Order: the file itself, then sorted siblings."""
+    if os.path.isdir(arg):
+        return sorted(glob.glob(os.path.join(arg, "*.jsonl")))
+    out: List[str] = []
+    if os.path.exists(arg):
+        out.append(arg)
+    if arg.endswith(".jsonl"):
+        for sib in sorted(glob.glob(arg[:-len(".jsonl")] + ".*.jsonl")):
+            if sib not in out:
+                out.append(sib)
+    return out
+
+
+def read_records(path: str) -> List[Dict[str, Any]]:
+    """Complete, well-formed dict lines only (a SIGKILLed writer's torn
+    tail is dropped, same contract as ``trace.LogTail``)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        fh = open(path, "rb")
+    except OSError:
+        return out
+    with fh:
+        for line in fh:
+            if not line.endswith(b"\n"):
+                break
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line.decode("utf-8", errors="replace"))
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def _tag(path: str, manifest: Optional[Dict[str, Any]]) -> str:
+    """Thread name for one source log: the supervised sibling tag when
+    the filename carries one, else the manifest's tool, else the stem."""
+    base = os.path.basename(path)
+    if base.endswith(".jsonl"):
+        base = base[:-len(".jsonl")]
+    parts = base.rsplit(".", 1)
+    if len(parts) == 2 and parts[1]:
+        return parts[1]  # run.supervisor.jsonl -> "supervisor"
+    if manifest is not None and isinstance(manifest.get("tool"), str):
+        return manifest["tool"]
+    return base
+
+
+def _us(t: float) -> float:
+    return round(float(t) * 1e6, 1)
+
+
+def build_trace(paths: List[str]) -> Dict[str, Any]:
+    """Fold every log into one Chrome-trace object (see module doc)."""
+    events: List[Dict[str, Any]] = []
+    pids: Dict[str, int] = {}  # host|pN -> chrome pid
+    trace_ids = set()
+    files_read = 0
+    for tid_num, path in enumerate(paths, start=1):
+        recs = read_records(path)
+        if not recs:
+            continue
+        files_read += 1
+        manifest = recs[0] if recs[0].get("kind") == "manifest" else None
+        prov = (manifest or {}).get("provenance") or {}
+        host = prov.get("hostname") or "?"
+        pidx = prov.get("process_index")
+        group = f"{host}|p{pidx if isinstance(pidx, int) else '?'}"
+        if group not in pids:
+            pids[group] = len(pids) + 1
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pids[group],
+                "tid": 0, "args": {"name": f"{host} p{pidx}/"
+                                           f"{prov.get('process_count')}"}})
+        pid = pids[group]
+        thread = _tag(path, manifest)
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tid_num, "args": {"name": thread}})
+        mtrace = (manifest or {}).get("trace") or {}
+        if mtrace.get("trace_id"):
+            trace_ids.add(mtrace["trace_id"])
+        src = os.path.basename(path)
+        for rec in recs:
+            kind = rec.get("kind")
+            t = rec.get("t")
+            if kind == "span":
+                start, dur = rec.get("start"), rec.get("dur_s")
+                if not isinstance(start, (int, float)) or \
+                        not isinstance(dur, (int, float)):
+                    continue
+                if rec.get("trace_id"):
+                    trace_ids.add(rec["trace_id"])
+                args = dict(rec.get("attrs") or {})
+                args.update({"trace_id": rec.get("trace_id"),
+                             "span_id": rec.get("span_id"),
+                             "parent_id": rec.get("parent_id"),
+                             "file": src})
+                events.append({
+                    "name": str(rec.get("name") or "span"), "ph": "X",
+                    "cat": "span", "ts": _us(start),
+                    "dur": max(1.0, _us(dur)), "pid": pid,
+                    "tid": tid_num, "args": args})
+            elif kind == "chunk" and isinstance(t, (int, float)):
+                wall = rec.get("wall_s")
+                if not isinstance(wall, (int, float)) or wall < 0:
+                    continue
+                n = rec.get("chunk")
+                args = {k: rec.get(k) for k in
+                        ("chunk", "steps", "ms_per_step", "recompiled",
+                         "members") if rec.get(k) is not None}
+                args["file"] = src
+                events.append({
+                    "name": f"chunk {n}", "ph": "X", "cat": "chunk",
+                    "ts": _us(t - wall), "dur": max(1.0, _us(wall)),
+                    "pid": pid, "tid": tid_num, "args": args})
+            elif kind in _INSTANT_KINDS and isinstance(t, (int, float)):
+                name = kind
+                if kind == "heartbeat":
+                    name = f"heartbeat {rec.get('verdict')}"
+                elif kind == "launch":
+                    name = f"launch attempt {rec.get('attempt')}"
+                elif kind == "exchange":
+                    name = f"exchange {rec.get('mode')}"
+                args = {k: v for k, v in rec.items()
+                        if k not in ("schema", "kind", "t")
+                        and isinstance(v, (str, int, float, bool))}
+                args["file"] = src
+                events.append({"name": name, "ph": "i", "s": "t",
+                               "cat": kind, "ts": _us(t), "pid": pid,
+                               "tid": tid_num, "args": args})
+    spans = sum(1 for e in events if e.get("cat") == "span")
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "tool": "obs_trace_export",
+            "files": files_read,
+            "processes": len(pids),
+            "spans": spans,
+            "trace_ids": sorted(trace_ids),
+        },
+    }
+
+
+def validate_export(obj: Any) -> List[str]:
+    """Schema gate on the built trace: list EVERY problem, empty = ok."""
+    problems: List[str] = []
+    if not isinstance(obj, dict):
+        return [f"export must be a dict, got {type(obj).__name__}"]
+    evs = obj.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["traceEvents must be a list"]
+    for i, e in enumerate(evs):
+        where = f"traceEvents[{i}]"
+        if not isinstance(e, dict):
+            problems.append(f"{where}: not a dict")
+            continue
+        ph = e.get("ph")
+        if ph not in ("X", "i", "M"):
+            problems.append(f"{where}: ph must be X/i/M (got {ph!r})")
+            continue
+        if not isinstance(e.get("name"), str) or not e["name"]:
+            problems.append(f"{where}: name must be a nonempty str")
+        if not isinstance(e.get("pid"), int) or \
+                not isinstance(e.get("tid"), int):
+            problems.append(f"{where}: pid/tid must be ints")
+        if ph in ("X", "i"):
+            if not isinstance(e.get("ts"), (int, float)):
+                problems.append(f"{where}: ts must be a number")
+        if ph == "X":
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)) or dur <= 0:
+                problems.append(f"{where}: X needs dur > 0 (got {dur!r})")
+        if ph == "M" and not isinstance(e.get("args"), dict):
+            problems.append(f"{where}: M needs an args dict")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("paths", nargs="+",
+                    help="telemetry JSONL file(s), a directory, or a "
+                         "supervised run's base path (siblings "
+                         "auto-discovered)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output JSON path (default: stdout)")
+    a = ap.parse_args(argv)
+    paths: List[str] = []
+    for arg in a.paths:
+        for p in discover(arg):
+            if p not in paths:
+                paths.append(p)
+    if not paths:
+        print(f"obs_trace_export: no logs found under {a.paths}",
+              file=sys.stderr)
+        return 2
+    obj = build_trace(paths)
+    problems = validate_export(obj)
+    if problems:
+        print("obs_trace_export: invalid export:\n  "
+              + "\n  ".join(problems), file=sys.stderr)
+        return 1
+    body = json.dumps(obj, default=str)
+    if a.out:
+        with open(a.out, "w") as fh:
+            fh.write(body)
+        meta = obj["otherData"]
+        print(f"obs_trace_export: {len(obj['traceEvents'])} events from "
+              f"{meta['files']} log(s), {meta['processes']} process "
+              f"track(s), {meta['spans']} spans, trace_ids="
+              f"{meta['trace_ids']} -> {a.out}")
+    else:
+        print(body)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

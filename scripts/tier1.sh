@@ -129,6 +129,39 @@ print('supervisor smoke ok: resumed_from_step', resumed[0])
 " || rc=1
 timeout -k 10 120 python scripts/obs_report.py \
   /tmp/_t1_sup/run.supervisor.jsonl --check > /dev/null || rc=1
+# Span-trace export smoke (round 16): the supervised wedge run above
+# left a supervisor log plus two attempt logs whose spans share ONE
+# trace (OBS_TRACE_CONTEXT propagation).  The export must fold all
+# three into a single Perfetto/Chrome JSON — the script schema-
+# validates the event list itself before writing (nonzero exit on any
+# problem) — and the leg asserts the causal claims: a single trace_id
+# across supervisor and both child attempts, and a restart span
+# carrying resumed_from_step=30 ordered BETWEEN the two attempt spans.
+rm -f /tmp/_t1_trace.json
+timeout -k 10 120 python scripts/obs_trace_export.py /tmp/_t1_sup/run.jsonl \
+  -o /tmp/_t1_trace.json || rc=1
+timeout -k 10 120 python -c "
+import json
+obj = json.load(open('/tmp/_t1_trace.json'))
+spans = [e for e in obj['traceEvents']
+         if e.get('ph') == 'X' and e.get('cat') == 'span']
+tids = {e['args']['trace_id'] for e in spans}
+assert len(tids) == 1, f'expected one trace_id, got {tids}'
+files = {e['args']['file'] for e in spans}
+need = {'run.supervisor.jsonl', 'run.attempt0.jsonl',
+        'run.attempt1.jsonl'}
+assert need <= files, f'spans missing from {need - files}'
+attempts = sorted((e for e in spans if e['name'] == 'attempt'),
+                  key=lambda e: e['ts'])
+restart = [e for e in spans if e['name'] == 'restart'][0]
+assert restart['args']['resumed_from_step'] == 30, restart['args']
+assert attempts[0]['ts'] + attempts[0]['dur'] <= restart['ts'], \
+    'restart span must start after attempt 0 ends'
+assert restart['ts'] + restart['dur'] <= attempts[1]['ts'], \
+    'restart span must end before attempt 1 starts'
+print('span smoke ok: trace', tids.pop(), 'across', len(files),
+      'logs,', len(spans), 'spans')
+" || rc=1
 # Live-console smoke (obs/serve.py): a CPU run with --serve 0 must
 # expose /metrics, /status.json, and an incremental /events?after=
 # slice over stdlib urllib WHILE the run is in flight (the scraper

@@ -177,8 +177,14 @@ def test_cli_log_valid_with_chunks_cost_and_summary(cli_log):
     assert manifest["run"]["stencil"] == "heat2d"
     kinds = [e["kind"] for e in events]
     assert kinds.count("chunk") == 4  # 8 iters / log-every 2
-    assert "costmodel" in kinds and kinds[-1] == "summary"
-    summary = events[-1]
+    assert "costmodel" in kinds
+    # the session ROOT SPAN closes every log (round 16 — its duration
+    # covers the whole session, so it must be emitted last); the
+    # summary is the final non-span record
+    assert events[-1]["kind"] == "span" and events[-1]["name"] == "cli"
+    non_span = [e for e in events if e["kind"] != "span"]
+    assert non_span[-1]["kind"] == "summary"
+    summary = non_span[-1]
     assert summary["runtime"]["n_chunks"] == 4
     assert summary["runtime"]["steps"] == 8
     assert summary["runtime"]["steady"]["ms_per_step_p50"] > 0
@@ -200,7 +206,8 @@ def test_scaling_emits_same_schema(tmp_path):
     assert manifest["tool"] == "scaling"
     rungs = [e for e in events if e["kind"] == "rung"]
     assert len(rungs) == int(math.log2(len(jax.devices()))) + 1
-    assert events[-1]["kind"] == "summary"
+    non_span = [e for e in events if e["kind"] != "span"]
+    assert non_span[-1]["kind"] == "summary"
 
 
 def test_measure_emits_same_schema(tmp_path, monkeypatch):
@@ -217,8 +224,9 @@ def test_measure_emits_same_schema(tmp_path, monkeypatch):
     labels = [e for e in events if e["kind"] == "label"]
     assert [e["label"] for e in labels] == ["heat2d_tiny"]
     assert labels[0]["status"] in ("ok", "error")  # noise floor may trip
-    assert events[-1]["kind"] == "summary"
-    assert events[-1]["labels_run"] == 1
+    non_span = [e for e in events if e["kind"] != "span"]
+    assert non_span[-1]["kind"] == "summary"
+    assert non_span[-1]["labels_run"] == 1
 
 
 def test_bench_telemetry_and_wedge_context(tmp_path, monkeypatch):
@@ -555,8 +563,9 @@ def test_session_error_event_and_finish_idempotent(tmp_path):
                               with_heartbeat=False):
             raise RuntimeError("boom")
     manifest, events = trace.validate_log(path)
-    assert events[-1]["kind"] == "error"
-    assert "boom" in events[-1]["error"]
+    non_span = [e for e in events if e["kind"] != "span"]
+    assert non_span[-1]["kind"] == "error"
+    assert "boom" in non_span[-1]["error"]
 
     path2 = str(tmp_path / "fin.jsonl")
     s = obs.open_session(path2, "cli", {}, with_heartbeat=False)
@@ -564,7 +573,8 @@ def test_session_error_event_and_finish_idempotent(tmp_path):
     s.finish(mcells_per_s=2.0)  # idempotent: second call is a no-op
     s.close()
     _, events = trace.validate_log(path2)
-    assert [e["kind"] for e in events] == ["summary"]
+    # exactly one summary, then the root span (round 16) closes the log
+    assert [e["kind"] for e in events] == ["summary", "span"]
     assert events[0]["mcells_per_s"] == 1.0
 
 
