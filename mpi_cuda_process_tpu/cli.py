@@ -183,6 +183,43 @@ def build_parser() -> argparse.ArgumentParser:
                         "finite inside the jitted scan (the error names the "
                         "exact failing step) plus index bounds checks; "
                         "slower — complements --check-finite's polling")
+    p.add_argument("--health", action="store_true",
+                   help="numerics sentinel (obs/health.py): at every "
+                        "chunk boundary, a separately-jitted fully "
+                        "sharded health reduction computes per-field "
+                        "global min/max/mean and NaN/Inf counts plus "
+                        "the op's REGISTERED conservation invariant "
+                        "(heat: total heat; wave: the leapfrog "
+                        "scheme's exactly-conserved discrete energy; "
+                        "sor: the decreasing residual norm) — one "
+                        "device_get per boundary, no host gather of "
+                        "field state, zero ops in the jitted step.  A "
+                        "trend detector (relative drift vs the "
+                        "chunk-0 baseline, per-op tolerances) turns "
+                        "the stats into 'health' events and a "
+                        "DIVERGED verdict that aborts the run and "
+                        "flows everywhere WEDGED does: the supervisor "
+                        "gives up WITHOUT a checkpoint-restart loop "
+                        "(resuming into the same blow-up is waste), "
+                        "ledger ingest quarantines the row with "
+                        "reason 'diverged', /status.json and obs_top "
+                        "render it.  With no logging cadence a "
+                        "~8-chunk boundary cadence is synthesized")
+    p.add_argument("--halo-audit", type=int, default=0, metavar="K",
+                   help="opt-in exchange audit (obs/health.py), every "
+                        "K chunks: re-exchange the ghost slabs "
+                        "through the run's transport (--exchange "
+                        "ppermute|rdma, any mesh family) and "
+                        "bit-compare every received slab against the "
+                        "neighbor interior it must equal (computed "
+                        "independently from the global array view — "
+                        "the two sides share no exchange code).  A "
+                        "mismatch aborts with the exact (field, axis, "
+                        "direction, ring-shard) site — the tool that "
+                        "localizes an exchange bug in minutes.  "
+                        "Needs a spatially sharded --mesh; costs one "
+                        "extra exchange round per audited chunk, so "
+                        "keep K coarse on production runs")
     p.add_argument("--tol", type=float, default=0.0,
                    help="stop when the residual max|u - u_prev_check| over a "
                         "--tol-check-every-step interval drops below TOL "
@@ -301,6 +338,7 @@ def config_from_args(argv=None) -> RunConfig:
         fuse=a.fuse, fuse_kind=a.fuse_kind, exchange=a.exchange,
         tol=a.tol, tol_check_every=a.tol_check_every,
         check_finite=a.check_finite, debug_checks=a.debug_checks,
+        health=a.health, halo_audit=a.halo_audit,
         dump_every=a.dump_every, dump_dir=a.dump_dir,
         mem_check=a.mem_check,
         supervise=a.supervise, max_restarts=a.max_restarts,
@@ -1000,6 +1038,17 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
         raise ValueError("--profile scopes one steady-state chunk; "
                          "--tol runs inside a single while_loop with no "
                          "chunk boundary to scope")
+    if cfg.halo_audit < 0:
+        raise ValueError("--halo-audit takes a positive chunk cadence K")
+    if cfg.halo_audit and not (cfg.mesh and any(c > 1 for c in cfg.mesh)):
+        raise ValueError(
+            "--halo-audit re-exchanges ghost slabs across a device "
+            "mesh; it needs a spatially sharded --mesh (an unsharded "
+            "run has no exchange to audit)")
+    if cfg.halo_audit and cfg.tol > 0:
+        raise ValueError(
+            "--halo-audit runs at chunk boundaries; --tol runs inside "
+            "one while_loop with no boundary to audit at")
     _check_mem_budget(cfg)
     mesh_lib.bootstrap_distributed()
     build_t0, build_m0 = time.time(), time.perf_counter()
@@ -1037,6 +1086,28 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
 
     cells = math.prod(cfg.grid) * max(1, cfg.ensemble)
 
+    # Numerics sentinel + halo audit (obs/health.py): both are strictly
+    # chunk-boundary observers — a separately-jitted reduction (health)
+    # and a separately-jitted exchange-compare (audit), never ops in the
+    # step program (the jaxpr-invariance pin extends to --health).
+    monitor = auditor = None
+    if cfg.health:
+        from .obs import health as health_lib
+
+        monitor = health_lib.HealthMonitor(
+            st, trace=session.trace if session is not None else None,
+            ensemble=cfg.ensemble,
+            spans=session.spans if session is not None else None)
+    if cfg.halo_audit:
+        from .obs import health as health_lib
+
+        auditor = health_lib.HaloAuditor(
+            st, mesh_lib.make_mesh(cfg.mesh,
+                                   ensemble=cfg.ensemble_mesh or 1),
+            cfg.grid, exchange=cfg.exchange, periodic=cfg.periodic,
+            ensemble=cfg.ensemble,
+            trace=session.trace if session is not None else None)
+
     if cfg.tol > 0:
         if cfg.log_every or cfg.checkpoint_every or \
                 cfg.dump_every or cfg.check_finite or cfg.debug_checks:
@@ -1069,6 +1140,11 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
                 else cfg.tol_check_every)
         dt = time.perf_counter() - t0
         n_done = n_calls * unit
+        if monitor is not None:
+            # one while_loop = one chunk: the sentinel checks the final
+            # state (a non-finite state never converges — the verdict
+            # names why the loop ran to its cap)
+            monitor.check_or_raise(start_step + n_done, fields, chunk=0)
         mcells = cells * n_done / dt / 1e6 if n_done else 0.0
         log.info(
             "converged=%s after %d steps (residual %.3e, tol %.1e) in %.3fs"
@@ -1089,6 +1165,8 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
         os.makedirs(cfg.dump_dir, exist_ok=True)
 
     last_ok = [start_step]
+    chunk_count = [0]
+    audits_run = [0]
 
     def callback(done_in_run, fs):
         step = start_step + done_in_run * max(1, cfg.fuse)
@@ -1098,6 +1176,15 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
         # surviving checkpoint, which is what a real mid-exchange death
         # looks like to the resume path.
         faults.maybe_fire("exchange", step=step)
+        replaced = None
+        if faults.injected_numeric_poison(step) is not None:
+            # numerics fault site: one NaN cell, host-side, into the
+            # state that CONTINUES (the driver adopts the returned
+            # fields) — the deterministic stand-in for a real bit flip
+            # that makes the DIVERGED path provable end to end
+            from .obs import health as health_lib
+
+            fs = replaced = health_lib.apply_nan_poison(fs)
         if cfg.check_finite and step % cfg.check_finite == 0:
             for i, f in enumerate(fs):
                 if not jnp.issubdtype(f.dtype, jnp.inexact):
@@ -1114,6 +1201,17 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
             d = diagnostics.field_diagnostics(
                 st, fs, step_fn=None if cfg.fuse else step_fn)
             log.info("step %d  %s", step, diagnostics.format_diagnostics(d))
+        # Health sentinel + halo audit: BEFORE this boundary's
+        # checkpoint save, so a diverged (or poisoned) state is never
+        # checkpointed — the supervisor must give up, not resume into
+        # the blow-up.
+        chunk = chunk_count[0]
+        chunk_count[0] += 1
+        if monitor is not None:
+            monitor.check_or_raise(step, fs, chunk=chunk)
+        if auditor is not None and (chunk + 1) % cfg.halo_audit == 0:
+            audits_run[0] += 1
+            auditor.audit_or_raise(fs, step, chunk=chunk)
         if cfg.checkpoint_every and cfg.checkpoint_dir and \
                 step % cfg.checkpoint_every == 0:
             with _session_span(session, "checkpoint", step=step):
@@ -1123,12 +1221,21 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
             native.async_write_npy(
                 os.path.join(cfg.dump_dir, f"step_{step:08d}.npy"),
                 np.asarray(fs[0]))
+        return replaced
 
     intervals = [v for v in (cfg.log_every, cfg.checkpoint_every,
                              cfg.check_finite,
                              cfg.dump_every if cfg.dump_dir else 0) if v]
     interval = math.gcd(*intervals) if len(intervals) > 1 else (
         intervals[0] if intervals else 0)
+    if (cfg.health or cfg.halo_audit) and not interval:
+        # no logging cadence: synthesize ~8 chunk boundaries so the
+        # sentinel/audit have boundaries to run at (the --profile
+        # trick, coarser); multiples of the fused step unit so the
+        # cadence accounting below holds unchanged
+        unit = max(1, cfg.fuse)
+        if remaining >= 2 * unit:
+            interval = max(1, (remaining // unit) // 8) * unit
 
     # With temporal blocking the step_fn advances cfg.fuse steps per call:
     # scan over remaining/K calls, and run the callback cadence in K-units.
@@ -1190,6 +1297,14 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
             prof.close()  # never leave a trace session open (jax
             # refuses nesting; the error path must not poison the next run)
     dt = time.perf_counter() - t0
+
+    # Single-chunk runs (no boundaries): the sentinel/audit still judge
+    # the FINAL state once, so `--health` without any cadence cannot
+    # silently observe nothing.
+    if monitor is not None and monitor.checks == 0:
+        monitor.check_or_raise(cfg.iters, fields)
+    if auditor is not None and audits_run[0] == 0:
+        auditor.audit_or_raise(fields, cfg.iters)
 
     if prof is not None:
         from .obs import profile as profile_lib

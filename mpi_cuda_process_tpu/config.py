@@ -67,6 +67,18 @@ class RunConfig:
     exchange: str = "ppermute"
     check_finite: int = 0  # >0: assert all fields finite every N steps
     debug_checks: bool = False  # checkify NaN/bounds checks, step-localized
+    # numerics sentinel (obs/health.py): a separately-jitted sharded
+    # health reduction at every chunk boundary — per-field min/max/mean
+    # + NaN/Inf counts + the op's registered conservation invariant —
+    # with a trend detector whose DIVERGED verdict flows everywhere
+    # WEDGED does (supervisor gives up without restart, ledger
+    # quarantines, /status.json//obs_top render it)
+    health: bool = False
+    # opt-in halo-exchange audit (obs/health.py): every K chunks,
+    # re-exchange the ghost slabs through the run's transport and
+    # bit-compare every received slab against the neighbor interior it
+    # must equal; 0 = off.  Needs a spatially sharded --mesh.
+    halo_audit: int = 0
     tol: float = 0.0  # >0: stop when residual < tol (lax.while_loop runner)
     tol_check_every: int = 10  # residual check cadence for --tol
     dump_every: int = 0  # >0: async .npy snapshots of field0 every N steps
@@ -130,7 +142,8 @@ _ARGV_SKIP = frozenset({"supervise", "max_restarts", "restart_backoff",
 LIFECYCLE_FIELDS = frozenset({
     "log_every", "checkpoint_every", "checkpoint_dir",
     "checkpoint_backend", "resume", "render", "profile_dir", "profile",
-    "check_finite", "debug_checks", "dump_every", "dump_dir",
+    "check_finite", "debug_checks", "health", "halo_audit",
+    "dump_every", "dump_dir",
     "telemetry", "mem_check", "supervise", "max_restarts",
     "restart_backoff", "supervise_stall_s", "serve_port",
 })

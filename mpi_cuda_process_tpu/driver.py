@@ -373,6 +373,15 @@ def run_simulation(
     (``start_step`` is where this run resumes from), so a run resumed from a
     non-multiple step keeps logging/checkpointing on the same cadence.
 
+    A callback may RETURN a replacement fields tuple (same structure,
+    shapes, dtypes) and the run carries it forward — the deterministic
+    state-corruption hook the ``numerics`` fault site uses
+    (``resilience/faults.py``: a NaN poisoned at a chunk boundary must
+    corrupt the state that CONTINUES, like a real bit flip would).
+    ``None`` — the normal case — keeps the state; the jitted step
+    program is untouched either way (the swap is host-side, between
+    chunks).
+
     ``runner_factory(step_fn, n)`` overrides how a chunk is executed; the
     returned runner is called as ``runner(fields, abs_start_step)`` (the
     hook through which :func:`make_checked_runner` instruments debug runs —
@@ -421,5 +430,7 @@ def run_simulation(
         fields = _run_chunk(runners[chunk], fields, chunk, abs_step)
         done += chunk
         if callback is not None:
-            callback(done, fields)
+            replacement = callback(done, fields)
+            if replacement is not None:
+                fields = replacement
     return fields

@@ -131,7 +131,14 @@ class RunHandle:
     def status(self) -> Dict[str, Any]:
         """The live status payload — identical vocabulary to
         ``/status.json`` (``obs/metrics.RunMetrics.status``), plus the
-        handle's request identity and phase."""
+        handle's request identity and phase.
+
+        The ``health`` key is ALWAYS present (None before the first
+        sentinel check; the latest ``health`` record of a ``--health``
+        run after), and a DIVERGED verdict dominates ``verdict`` — the
+        contract a scheduler (ROADMAP item 1) reads to evict diverged
+        members without parsing logs; :meth:`health_verdict` is the
+        one-call form."""
         from .obs.metrics import RunMetrics
 
         rm = RunMetrics()
@@ -159,6 +166,12 @@ class RunHandle:
                     if v is not None})
         out["request"] = req
         return out
+
+    def health_verdict(self) -> Optional[str]:
+        """The latest numerics-sentinel verdict for this run (None when
+        no health check has landed yet, ``"HEALTHY"``/``"DIVERGED"``
+        after) — the eviction signal, without the full status walk."""
+        return (self.status().get("health") or {}).get("verdict")
 
 
 class SimulationEngine:

@@ -16,6 +16,13 @@ validator is the single definition) and the same event vocabulary:
 * ``span``       — one finished span of the causal timeline
   (``spans.py``: trace_id/span_id/parent_id + wall start + duration;
   the root span closes every log)
+* ``health``     — one numerics-sentinel check (``health.py``:
+  per-field min/max/mean + NaN/Inf counts, the op's registered
+  conservation invariant, and the HEALTHY/DIVERGED verdict that flows
+  through supervisor, ledger quarantine, and ``/status.json``)
+* ``halo_audit`` — one bit-exact ghost-slab audit pass (``health.py``
+  ``--halo-audit``: received slabs vs neighbor interiors, localized
+  to (field, axis, direction, ring-shard) on mismatch)
 * ``error`` / ``summary`` — how the run ended
 
 Sibling stores complete the layer: ``profile.py`` wraps a

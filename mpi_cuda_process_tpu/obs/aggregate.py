@@ -161,7 +161,9 @@ class HostAggregator:
         worst = "ALIVE"
         if any(r.get("give_up") for r in rows):
             worst = "GAVE_UP"
-        for v in ("WEDGED", "STALLED"):
+        # DIVERGED outranks liveness trouble: a host that is provably
+        # computing garbage is worse than one that is merely stuck
+        for v in ("DIVERGED", "WEDGED", "STALLED"):
             if v in verdicts:
                 worst = v
                 break

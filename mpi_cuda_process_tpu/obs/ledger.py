@@ -117,12 +117,15 @@ def classify(value: Any, *, stale: bool = False, suspect: bool = False,
              error: Optional[str] = None,
              backend: Optional[str] = None,
              expected_backend: Optional[str] = None,
-             heartbeat: Optional[str] = None) -> Tuple[str, Optional[str]]:
+             heartbeat: Optional[str] = None,
+             health: Optional[str] = None) -> Tuple[str, Optional[str]]:
     """Quarantine decision for one measurement: ``(status, reason)``.
 
     Order matters only for which reason is reported; ANY tripped rule
     quarantines.  A value of 0.0 (the wedged scoreboards) is never a
-    measurement.
+    measurement.  A DIVERGED health verdict (obs/health.py) quarantines
+    with reason ``diverged``: the throughput of a run computing garbage
+    is not a baseline candidate, however fast it looked.
     """
     if error:
         return "quarantined", f"errored: {str(error)[:120]}"
@@ -134,6 +137,8 @@ def classify(value: Any, *, stale: bool = False, suspect: bool = False,
         return "quarantined", (f"backend mismatch: record says "
                                f"{backend!r}, provenance says "
                                f"{expected_backend!r}")
+    if health == "DIVERGED":
+        return "quarantined", "diverged"
     if heartbeat in ("WEDGED", "STALLED"):
         return "quarantined", f"heartbeat verdict {heartbeat}"
     if not isinstance(value, (int, float)) or value <= 0.0:
@@ -146,6 +151,7 @@ def make_row(label: str, value: Any, *, source: str,
              measured_at: Optional[float] = None,
              ms_per_step: Optional[float] = None,
              heartbeat: Optional[str] = None,
+             health: Optional[str] = None,
              provenance: Optional[Dict[str, Any]] = None,
              detail: Optional[Dict[str, Any]] = None,
              stale: bool = False, suspect: bool = False,
@@ -156,7 +162,7 @@ def make_row(label: str, value: Any, *, source: str,
     status, reason = classify(
         value, stale=stale, suspect=suspect, error=error,
         backend=backend, expected_backend=expected_backend,
-        heartbeat=heartbeat)
+        heartbeat=heartbeat, health=health)
     key = make_key(label, backend=backend or expected_backend, **key_kw)
     row: Dict[str, Any] = {
         "schema": LEDGER_SCHEMA,
@@ -176,6 +182,10 @@ def make_row(label: str, value: Any, *, source: str,
         "provenance": provenance or None,
         "detail": detail or None,
     }
+    if health is not None:
+        # only when a health verdict exists: every pre-existing row
+        # (and its re-ingest) stays byte-identical
+        row["health"] = health
     validate_row(row)
     return row
 
@@ -412,6 +422,14 @@ def rows_from_log(log_path: str) -> List[Dict[str, Any]]:
         elif e.get("kind") == "summary" and isinstance(
                 e.get("heartbeat"), dict):
             hb = e["heartbeat"].get("verdict") or hb
+    # health sentinel verdict (obs/health.py): once DIVERGED, the run's
+    # numbers are garbage-adjacent — every row of this log quarantines
+    # with reason 'diverged' (a later HEALTHY check cannot un-diverge a
+    # run; the CLI aborts at the first DIVERGED boundary anyway)
+    health = None
+    for e in events:
+        if e.get("kind") == "health":
+            health = e.get("verdict") if health != "DIVERGED" else health
     rows: List[Dict[str, Any]] = []
     # restart trail (resilience/): a resumed run names its resume point
     # in a 'resume' event; the row detail carries it so downstream
@@ -428,7 +446,7 @@ def rows_from_log(log_path: str) -> List[Dict[str, Any]]:
         for s in summaries:
             rows.append(make_row(
                 _cli_label(run), s.get("mcells_per_s"), source=source,
-                measured_at=s.get("t"), heartbeat=hb,
+                measured_at=s.get("t"), heartbeat=hb, health=health,
                 expected_backend=prov.get("backend"),
                 provenance=_prov_subset(prov),
                 grid=run.get("grid"), mesh=run.get("mesh"),
@@ -436,6 +454,24 @@ def rows_from_log(log_path: str) -> List[Dict[str, Any]]:
                 flags=_flags(run), builder_rev=prov.get("builder_rev"),
                 detail={"resumed_from_step": resumed_from}
                 if resumed_from is not None else None))
+        if health == "DIVERGED" and not summaries:
+            # a diverged run aborts before its summary — the row still
+            # lands (value-less, quarantined 'diverged') so the ledger
+            # records that this config BLEW UP rather than nothing
+            div = [e for e in events if e.get("kind") == "health"
+                   and e.get("verdict") == "DIVERGED"]
+            detail = {"health_reason": str(div[-1].get("reason"))[:200]} \
+                if div and div[-1].get("reason") else None
+            rows.append(make_row(
+                _cli_label(run), None, source=source,
+                measured_at=div[-1].get("t") if div else None,
+                heartbeat=hb, health=health,
+                expected_backend=prov.get("backend"),
+                provenance=_prov_subset(prov),
+                grid=run.get("grid"), mesh=run.get("mesh"),
+                kind=run.get("fuse_kind"), dtype=run.get("dtype"),
+                flags=_flags(run), builder_rev=prov.get("builder_rev"),
+                detail=detail))
     elif tool == "bench":
         for e in events:
             if e.get("kind") != "result":
@@ -456,7 +492,7 @@ def rows_from_log(log_path: str) -> List[Dict[str, Any]]:
                 detail["attempts"] = e["attempts"]
             rows.append(make_row(
                 str(e.get("label")), e.get("mcells_per_s"), source=source,
-                measured_at=e.get("t"), heartbeat=hb,
+                measured_at=e.get("t"), heartbeat=hb, health=health,
                 error=(e.get("error") or None) if status in
                       ("error", "timeout", "missing") else None,
                 expected_backend=prov.get("backend"),
@@ -473,6 +509,7 @@ def rows_from_log(log_path: str) -> List[Dict[str, Any]]:
                 _scaling_label(run, e),
                 e.get("mcells_per_s") or e.get("ms_per_step_full"),
                 source=source, measured_at=e.get("t"), heartbeat=hb,
+                health=health,
                 expected_backend=prov.get("backend"),
                 provenance=_prov_subset(prov),
                 grid=e.get("grid"), mesh=e.get("mesh"),

@@ -346,6 +346,11 @@ class RunMetrics:
         self.costmodel: Optional[Dict[str, Any]] = None
         self.exchange: Optional[Dict[str, Any]] = None
         self.heartbeat: Optional[Dict[str, Any]] = None
+        # numerics sentinel (round 17): latest health check + audit —
+        # a DIVERGED health verdict dominates the status verdict (a
+        # fast, alive, WRONG run must never read as healthy)
+        self.health: Optional[Dict[str, Any]] = None
+        self.halo_audit: Optional[Dict[str, Any]] = None
         self.summary: Optional[Dict[str, Any]] = None
         self.launches: List[Dict[str, Any]] = []
         self.restarts: List[Dict[str, Any]] = []
@@ -543,6 +548,60 @@ class RunMetrics:
             "1 while the latest heartbeat verdict is STALLED/WEDGED").set(
             1.0 if verdict in ("STALLED", "WEDGED") else 0.0)
 
+    def _on_health(self, rec: Dict[str, Any]) -> None:
+        """Fold one numerics-sentinel check (obs/health.py)."""
+        self.health = rec
+        verdict = rec.get("verdict")
+        self.registry.counter("obs_health_checks_total",
+                              "health sentinel checks ingested").inc()
+        self.registry.info(
+            "obs_health_verdict",
+            "latest simulation-health verdict").set(
+            verdict=verdict,
+            invariant=(rec.get("invariant") or {}).get("name"),
+            reason=(str(rec.get("reason"))[:120]
+                    if rec.get("reason") else None))
+        self.registry.gauge(
+            "obs_health_diverged",
+            "1 while the latest health verdict is DIVERGED").set(
+            1.0 if verdict == "DIVERGED" else 0.0)
+        nf = rec.get("nonfinite_total")
+        if isinstance(nf, (int, float)):
+            self.registry.gauge(
+                "obs_health_nonfinite_values",
+                "NaN/Inf count across all fields, latest check").set(nf)
+        inv = rec.get("invariant") or {}
+        d = inv.get("drift")
+        if isinstance(d, list):
+            d = max((x for x in d if isinstance(x, (int, float))),
+                    default=None)
+        if isinstance(d, (int, float)) and math.isfinite(d):
+            self.registry.gauge(
+                "obs_health_invariant_drift",
+                "registered-invariant drift vs the chunk-0 baseline "
+                "(worst member)").set(d)
+        wf = rec.get("worst_field") or {}
+        if isinstance(wf.get("drift"), (int, float)):
+            self.registry.gauge(
+                "obs_health_worst_field_drift",
+                "worst per-field mean drift vs the chunk-0 baseline "
+                "(informational)").set(wf["drift"])
+
+    def _on_halo_audit(self, rec: Dict[str, Any]) -> None:
+        self.halo_audit = rec
+        self.registry.counter("obs_halo_audits_total",
+                              "halo-exchange audit passes").inc()
+        mm = rec.get("mismatch_total")
+        if isinstance(mm, (int, float)) and mm:
+            self.registry.counter(
+                "obs_halo_audit_mismatches_total",
+                "bit-mismatched received-slab words found by the "
+                "halo audit").inc(mm)
+        self.registry.gauge(
+            "obs_halo_audit_ok",
+            "1 while the latest halo audit bit-matched everywhere").set(
+            1.0 if rec.get("ok") else 0.0)
+
     def _on_launch(self, rec: Dict[str, Any]) -> None:
         self.launches.append(rec)
         self.registry.gauge("obs_supervisor_attempts",
@@ -681,6 +740,10 @@ class RunMetrics:
         with self.registry.lock:
             hb = self.heartbeat
             verdict = hb.get("verdict") if hb else None
+            if (self.health or {}).get("verdict") == "DIVERGED":
+                # correctness dominates liveness: a run that diverged
+                # is lost no matter what the heartbeat says
+                verdict = "DIVERGED"
             out: Dict[str, Any] = {
                 "generated_at": time.time(),
                 "manifest": self.manifest,
@@ -691,6 +754,10 @@ class RunMetrics:
                 "chunks_recent": list(self.chunks_recent),
                 "throughput": self._throughput(),
                 "heartbeat": hb,
+                # always present (None before any check): the stable
+                # contract a scheduler reads to evict diverged members
+                # without parsing logs (engine.RunHandle.status too)
+                "health": self.health,
                 "launches": list(self.launches),
                 "restarts": list(self.restarts),
                 "give_up": self.give_up,
@@ -699,6 +766,8 @@ class RunMetrics:
                 "summary": self.summary,
                 "errors": list(self.errors),
             }
+            if self.halo_audit is not None:
+                out["halo_audit"] = self.halo_audit
             if self.trace_id is not None:
                 out["trace_id"] = self.trace_id
             if self.time_to_first_chunk_s is not None:

@@ -16,7 +16,8 @@ import itertools
 
 import jax.numpy as jnp
 
-from .stencil import Stencil, axis_laplacian, interior, register, shifted
+from .stencil import (HealthInvariant, Stencil, axis_laplacian, interior,
+                      register, shifted)
 
 
 def _make_laplacian_update(ndim, alpha):
@@ -26,6 +27,24 @@ def _make_laplacian_update(ndim, alpha):
         return (u + alpha * lap,)
 
     return update
+
+
+def _heat_invariant(bc) -> HealthInvariant:
+    """Total heat (grid-mean heat density) for the diffusion family.
+
+    With Dirichlet walls the total legitimately drifts TOWARD the wall
+    temperature (the walls inject heat), so drift is measured against
+    the wall scale (``scale=|bc|``), not the possibly-near-zero initial
+    mean — saturation reads as drift < 1, a blow-up as drift >> rtol.
+    NaN/Inf poisoning turns the mean non-finite, the sentinel's hard
+    trigger, regardless of tolerance.
+    """
+
+    def total_heat(fields):
+        return jnp.mean(fields[0].astype(jnp.float32))
+
+    return HealthInvariant("total_heat", total_heat, rtol=2.0,
+                           scale=max(abs(float(bc)), 1.0))
 
 
 @register("heat2d")
@@ -40,6 +59,7 @@ def heat2d(alpha=0.25, bc=100.0, dtype=jnp.float32) -> Stencil:
         bc_value=(bc,),
         update=_make_laplacian_update(2, alpha),
         params={"alpha": alpha, "bc": bc},
+        invariant=_heat_invariant(bc),
     )
 
 
@@ -63,6 +83,7 @@ def heat3d(alpha=1.0 / 6.0, bc=100.0, dtype=jnp.float32) -> Stencil:
         bc_value=(bc,),
         update=_make_laplacian_update(3, alpha),
         params={"alpha": alpha, "bc": bc},
+        invariant=_heat_invariant(bc),
     )
 
 
@@ -104,6 +125,7 @@ def heat3d4th(alpha=0.1, bc=100.0, dtype=jnp.float32) -> Stencil:
         bc_value=(bc,),
         update=_make_lap4th_update(3, alpha),
         params={"alpha": alpha, "bc": bc},
+        invariant=_heat_invariant(bc),
     )
 
 
@@ -148,4 +170,5 @@ def heat3d27(alpha=0.15, bc=100.0, dtype=jnp.float32) -> Stencil:
         bc_value=(bc,),
         update=_heat3d27_update_factory(alpha),
         params={"alpha": alpha, "bc": bc},
+        invariant=_heat_invariant(bc),
     )

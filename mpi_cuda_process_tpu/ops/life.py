@@ -14,7 +14,19 @@ import itertools
 
 import jax.numpy as jnp
 
-from .stencil import Stencil, interior, register, shifted
+from .stencil import HealthInvariant, Stencil, interior, register, shifted
+
+
+def _population(fields):
+    # f32 accumulation: int32 sums are safe at these sizes, but the
+    # health transport is float either way
+    return jnp.sum(fields[0].astype(jnp.float32))
+
+
+# Track-only (rtol=None): Life's population legitimately wanders, so the
+# sentinel records it (and the cross-member spread for ensembles) but
+# never diverges a run on it; int state cannot hold NaN/Inf either.
+_LIFE_INVARIANT = HealthInvariant("population", _population, rtol=None)
 
 
 def _life_update(padded):
@@ -42,4 +54,5 @@ def life(dtype=jnp.int32) -> Stencil:
         bc_value=(0,),
         update=_life_update,
         params={},
+        invariant=_LIFE_INVARIANT,
     )

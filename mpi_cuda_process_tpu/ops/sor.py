@@ -26,7 +26,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-from .stencil import Stencil, axis_laplacian, register
+from .stencil import HealthInvariant, Stencil, axis_laplacian, register
 
 
 def _parity_mask(shape, ndim):
@@ -47,6 +47,31 @@ def _make_half_sweep(ndim, omega, color):
         return (jnp.where(mask, relaxed, u),)
 
     return update
+
+
+def _sor_invariant(ndim) -> HealthInvariant:
+    """RMS Laplace residual over the interior — the solver's progress.
+
+    SOR relaxes toward the Dirichlet-Laplace fixed point, so the
+    residual must (noisily) DECREASE: the sentinel's drift check is
+    one-sided (``mode="decrease"``) — only an increase past the
+    tolerance reads as divergence (omega outside the stable range, a
+    corrupted sweep), never the convergence the run exists for.
+    """
+
+    def residual_norm(fields):
+        u = fields[0].astype(jnp.float32)
+        core = u[(slice(1, -1),) * ndim]
+        acc = -2.0 * ndim * core
+        for d in range(ndim):
+            for s in (0, 2):
+                idx = [slice(1, -1)] * ndim
+                idx[d] = slice(s, s - 2 if s - 2 != 0 else None)
+                acc = acc + u[tuple(idx)]
+        return jnp.sqrt(jnp.mean(acc ** 2))
+
+    return HealthInvariant("residual_norm", residual_norm, rtol=0.5,
+                           mode="decrease")
 
 
 def _make_sor(name, ndim, omega, bc, dtype):
@@ -72,6 +97,7 @@ def _make_sor(name, ndim, omega, bc, dtype):
         params={"omega": omega, "bc": bc},
         phases=phases,
         parity_sensitive=True,
+        invariant=_sor_invariant(ndim),
     )
 
 

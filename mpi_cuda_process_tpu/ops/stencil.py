@@ -32,6 +32,47 @@ UpdateFn = Callable[[Fields], Fields]
 
 
 @dataclasses.dataclass(frozen=True)
+class HealthInvariant:
+    """A per-op scalar the numerics sentinel (obs/health.py) tracks.
+
+    Each op REGISTERS its own conservation (or monotone) invariant here —
+    the obs layer never hardcodes physics.  ``fn`` maps UNbatched,
+    unpadded fields to one jnp scalar (sharded-safe: pure jnp reductions
+    so XLA inserts the cross-device combines); the sentinel vmaps it
+    over the member axis for ensembles.
+
+    Attributes:
+      name: what the scalar is (``"total_heat"``, ``"discrete_energy"``,
+        ``"residual_norm"`` — the label telemetry and obs_top render).
+      fn: fields -> scalar (float32 accumulation recommended so bf16
+        states do not alias roundoff into drift).
+      rtol: relative-drift tolerance vs the chunk-0 baseline; ``None``
+        means track-only (the value is recorded but never diverges a
+        run — e.g. Life's population, which legitimately wanders).
+      mode: ``"conserve"`` (two-sided drift bound) or ``"decrease"``
+        (one-sided: only an INCREASE past the tolerance diverges — the
+        relaxation-residual case, where shrinking is the point).
+      scale: optional absolute floor for the drift denominator.  Ops
+        whose invariant legitimately grows toward a known saturation
+        value (Dirichlet heat: total heat rises toward the wall
+        temperature) register that value here, so drift is measured
+        against the physical scale instead of a near-zero baseline.
+    """
+
+    name: str
+    fn: Callable[[Fields], Array]
+    rtol: Optional[float] = None
+    mode: str = "conserve"
+    scale: Optional[float] = None
+
+    def __post_init__(self):
+        if self.mode not in ("conserve", "decrease"):
+            raise ValueError(
+                f"invariant {self.name!r}: mode must be 'conserve' or "
+                f"'decrease' (got {self.mode!r})")
+
+
+@dataclasses.dataclass(frozen=True)
 class Stencil:
     """A stencil model: everything that differed between the reference's two programs.
 
@@ -84,6 +125,9 @@ class Stencil:
     # periodic wraps over odd global extents) would flip colors, so the
     # steppers must reject them.
     parity_sensitive: bool = False
+    # The op's registered health invariant (obs/health.py reads it; ops
+    # without one still get per-field min/max/mean + NaN/Inf sentinels).
+    invariant: Optional[HealthInvariant] = None
 
     def __post_init__(self):
         if self.field_halos is None:

@@ -37,6 +37,15 @@ Sites (where the framework calls :func:`maybe_fire`):
 * ``heartbeat``  — the heartbeat's stall probe: action ``wedge`` makes
   the probe return a WEDGED verdict instead of spawning subprocesses
   (see :func:`injected_heartbeat_verdict`).
+* ``numerics``   — deterministic state corruption: action ``nan`` (its
+  only one) poisons ONE cell of the first inexact field with NaN at the
+  first chunk boundary at/past ``step=N`` (``FAULT_INJECT=numerics:
+  step=40:nan``).  The CLI consults :func:`injected_numeric_poison` at
+  its chunk boundary and applies ``obs.health.apply_nan_poison`` to the
+  carried state — host-side, so the jitted step program is untouched —
+  making the health sentinel's DIVERGED path (obs/health.py) provable
+  end to end: poison -> NaN count -> DIVERGED verdict -> supervisor
+  gives up WITHOUT a restart (resuming into the same blow-up is waste).
 
 Qualifiers: ``step=N``, ``name=STR``, ``before_write``/``during_write``,
 ``attempt=N``, ``always``.  A spec is active only on the restart attempt
@@ -71,8 +80,9 @@ ENV_VAR = "FAULT_INJECT"
 ATTEMPT_VAR = "FAULT_ATTEMPT"
 HANG_CAP_VAR = "FAULT_HANG_S"
 
-_SITES = ("exchange", "checkpoint", "compile", "label", "heartbeat")
-_ACTIONS = ("sigkill", "hang", "raise", "wedge")
+_SITES = ("exchange", "checkpoint", "compile", "label", "heartbeat",
+          "numerics")
+_ACTIONS = ("sigkill", "hang", "raise", "wedge", "nan")
 _PHASES = ("before_write", "during_write")
 
 
@@ -110,6 +120,9 @@ def parse_specs(text: str) -> List[FaultSpec]:
         if (action == "wedge") != (site == "heartbeat"):
             raise ValueError(f"fault spec {raw!r}: 'wedge' is the "
                              "heartbeat site's action (and its only one)")
+        if (action == "nan") != (site == "numerics"):
+            raise ValueError(f"fault spec {raw!r}: 'nan' is the "
+                             "numerics site's action (and its only one)")
         kw: Dict[str, object] = {}
         for q in parts[1:-1]:
             if q == "always":
@@ -209,6 +222,25 @@ def maybe_fire(site: str, step: Optional[int] = None,
         if _applies(spec, site, step, phase, name):
             _fired.add(spec.raw)
             _trigger(spec)
+
+
+def injected_numeric_poison(step: Optional[int] = None) -> Optional[FaultSpec]:
+    """The ``numerics`` site: one-shot, step-gated state poisoning.
+
+    Returns the first matching active spec (marking it fired — the
+    poison lands ONCE, like a real bit flip) or None.  The caller owns
+    the actual corruption (``obs.health.apply_nan_poison``): this module
+    stays pure stdlib, no jax.
+    """
+    for spec in active_specs():
+        if spec.site == "numerics" and spec.action == "nan" and \
+                _applies(spec, "numerics", step, None, None):
+            _fired.add(spec.raw)
+            print(f"[faults] firing {spec.raw!r} (pid {os.getpid()}, "
+                  f"attempt {current_attempt()})", file=sys.stderr,
+                  flush=True)
+            return spec
+    return None
 
 
 def injected_heartbeat_verdict() -> Optional[Dict[str, str]]:

@@ -64,6 +64,14 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 # wall-clock window was not coming back.
 KILL_VERDICTS = ("WEDGED", "STALLED")
 
+# Health verdicts (obs/health.py 'health' events) that end the run
+# WITHOUT a restart: a DIVERGED state is deterministic — the newest
+# checkpoint precedes (or contains) the blow-up, so checkpoint-restart
+# would loop into the same divergence, burning every restart budget on
+# a run that can never finish.  The supervisor gives up loudly with the
+# verdict instead.
+FATAL_VERDICTS = ("DIVERGED",)
+
 
 @dataclasses.dataclass
 class SuperviseResult:
@@ -200,9 +208,19 @@ def spawn_child(cmd: Sequence[str], *, attempt: int,
 
 # --------------------------------------------------------------- watch
 
+def _classify_event(e, kill_verdicts, fatal_verdicts):
+    """One tailed record -> ("verdict"|"fatal", value, detail) or None."""
+    if e.get("kind") == "heartbeat" and e.get("verdict") in kill_verdicts:
+        return ("verdict", e.get("verdict"), str(e.get("detail", ""))[:300])
+    if e.get("kind") == "health" and e.get("verdict") in fatal_verdicts:
+        return ("fatal", e.get("verdict"), str(e.get("reason", ""))[:300])
+    return None
+
+
 def watch_child(handle, tails, *, stall_timeout_s: float,
                 poll_s: float = 0.5,
                 kill_verdicts: Sequence[str] = KILL_VERDICTS,
+                fatal_verdicts: Sequence[str] = FATAL_VERDICTS,
                 clock: Callable[[], float] = time.monotonic,
                 sleep: Callable[[float], None] = time.sleep,
                 ) -> Tuple[str, Optional[Any], Optional[str]]:
@@ -213,10 +231,13 @@ def watch_child(handle, tails, *, stall_timeout_s: float,
     * ``"exit"``    — the child exited on its own (value = return code);
     * ``"verdict"`` — a kill-listed heartbeat verdict landed in the
       child's telemetry (value = the verdict);
+    * ``"fatal"``   — a NON-restartable health verdict (DIVERGED,
+      obs/health.py) landed: the caller must give up, not relaunch
+      (value = the verdict);
     * ``"stall"``   — no telemetry event for ``stall_timeout_s`` wall
       seconds (the no-evidence wedge: a hung compile, a dead writer).
 
-    The caller kills the child for the last two; this function never
+    The caller kills the child for the middle two; this function never
     kills anything itself (testable with fakes, no subprocesses).
     """
     last_event = clock()
@@ -225,20 +246,20 @@ def watch_child(handle, tails, *, stall_timeout_s: float,
         if events:
             last_event = clock()
             for e in events:
-                if e.get("kind") == "heartbeat" and \
-                        e.get("verdict") in kill_verdicts:
-                    return ("verdict", e.get("verdict"),
-                            str(e.get("detail", ""))[:300])
+                hit = _classify_event(e, kill_verdicts, fatal_verdicts)
+                if hit is not None:
+                    return hit
         rc = handle.poll()
         if rc is not None:
             # one final drain: the death may have been preceded by a
             # verdict the tail had not consumed yet (report the richer
-            # reason when both are true)
+            # reason when both are true).  A fatal health verdict wins
+            # over the bare exit code — the rc is a symptom, the
+            # DIVERGED record is the diagnosis.
             for e in (e for t in tails for e in t.poll()):
-                if e.get("kind") == "heartbeat" and \
-                        e.get("verdict") in kill_verdicts:
-                    return ("verdict", e.get("verdict"),
-                            str(e.get("detail", ""))[:300])
+                hit = _classify_event(e, kill_verdicts, fatal_verdicts)
+                if hit is not None:
+                    return hit
             return ("exit", int(rc), None)
         if clock() - last_event > stall_timeout_s:
             return ("stall", None,
@@ -255,6 +276,7 @@ def supervise(launcher, checkpoint_dir: Optional[str], *,
               backoff_max_s: float = 300.0, stall_timeout_s: float = 600.0,
               poll_s: float = 0.5,
               kill_verdicts: Sequence[str] = KILL_VERDICTS,
+              fatal_verdicts: Sequence[str] = FATAL_VERDICTS,
               session=None,
               sleep: Callable[[float], None] = time.sleep,
               clock: Callable[[], float] = time.monotonic,
@@ -270,6 +292,11 @@ def supervise(launcher, checkpoint_dir: Optional[str], *,
     ``restart`` / ``give_up`` events and the final ``summary`` — the
     obs-manifest trail the acceptance criteria read
     (``resumed_from_step`` rides every resuming launch event).
+
+    A ``fatal_verdicts`` health verdict (DIVERGED) short-circuits the
+    whole loop: kill, ``give_up`` carrying the verdict, nonzero exit —
+    never a restart, because resuming a deterministic blow-up from a
+    checkpoint at/under the blow-up reproduces it exactly.
     """
     def _event(kind: str, **payload: Any) -> None:
         if session is not None:
@@ -303,16 +330,33 @@ def supervise(launcher, checkpoint_dir: Optional[str], *,
             handle, tails = launcher(attempt, resume)
             outcome, value, detail = watch_child(
                 handle, tails, stall_timeout_s=stall_timeout_s,
-                poll_s=poll_s, kill_verdicts=kill_verdicts, clock=clock,
+                poll_s=poll_s, kill_verdicts=kill_verdicts,
+                fatal_verdicts=fatal_verdicts, clock=clock,
                 sleep=sleep)
             if outcome != "exit":
-                # verdict/stall: the child is alive but lost — kill the
-                # whole group and reap it so the relaunch never races a
-                # half-dead predecessor for the checkpoint dir
+                # verdict/fatal/stall: the child is alive but lost —
+                # kill the whole group and reap it so the relaunch (or
+                # the exit path) never races a half-dead predecessor
+                # for the checkpoint dir
                 with _span("kill", attempt=attempt, reason=outcome,
-                           verdict=value if outcome == "verdict" else None):
+                           verdict=value
+                           if outcome in ("verdict", "fatal") else None):
                     handle.kill()
                     handle.wait()
+        if outcome == "fatal":
+            # non-restartable: give up WITH the verdict, zero restarts
+            # spent on a deterministic blow-up (the DIVERGED contract)
+            reason = f"health verdict {value} (non-restartable)"
+            _event("give_up", attempts=attempt + 1, reason=reason,
+                   detail=detail, verdict=value, restarts=len(restarts))
+            _event("summary", ok=False, attempts=attempt + 1,
+                   restarts=len(restarts), gave_up=True, verdict=value)
+            return SuperviseResult(
+                ok=False, attempts=attempt + 1, restarts=restarts,
+                gave_up=True, final_rc=None,
+                resumed_from_step=resumed_from,
+                checkpoint_dir=checkpoint_dir,
+                telemetry=getattr(session, "path", None))
         if outcome == "exit" and value == 0:
             _event("summary", ok=True, attempts=attempt + 1,
                    restarts=len(restarts), resumed_from_step=resumed_from)

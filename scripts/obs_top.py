@@ -162,6 +162,66 @@ def _throughput_lines(status) -> list:
     return lines
 
 
+def _sim_health_lines(status) -> list:
+    """Numerics-sentinel line (obs/health.py): verdict, invariant
+    drift, NaN counts, worst-field drift, halo-audit state."""
+    h = status.get("health")
+    audit = status.get("halo_audit")
+    if not h and not audit:
+        return []
+    lines = []
+    if h:
+        bits = [f"verdict={h.get('verdict', '?')}"]
+        inv = h.get("invariant") or {}
+        if inv.get("name"):
+            d = inv.get("drift")
+            if isinstance(d, list):
+                d = max((x for x in d if isinstance(x, (int, float))),
+                        default=None)
+            bits.append(f"{inv['name']}={_fmtv(inv.get('value'))}"
+                        + (f" (drift {d:.3g}, tol {inv.get('rtol')})"
+                           if isinstance(d, (int, float)) else ""))
+        if h.get("nonfinite_total"):
+            bits.append(f"nonfinite={h['nonfinite_total']}")
+        wf = h.get("worst_field") or {}
+        if isinstance(wf.get("drift"), (int, float)):
+            bits.append(f"worst-field f{wf.get('field')} "
+                        f"drift {wf['drift']:.3g}")
+        ens = h.get("ensemble") or {}
+        if ens.get("members"):
+            bits.append(f"members={ens['members']}"
+                        + (f" spread={ens.get('spread'):.3g}"
+                           if isinstance(ens.get("spread"),
+                                         (int, float)) else ""))
+        lines.append("sim     " + "  ".join(bits))
+        if h.get("reason"):
+            lines.append(f"        {str(h['reason'])[:100]}")
+    if audit:
+        ok = "ok" if audit.get("ok") else "MISMATCH"
+        line = (f"halo    audit={ok}  sites={audit.get('sites_checked')}"
+                f"  backend={audit.get('backend')}")
+        if not audit.get("ok"):
+            bad = [s for s in (audit.get("sites") or [])
+                   if s.get("mismatch_count")]
+            for s in bad[:3]:
+                line += (f"\n        field {s.get('field')} axis "
+                         f"{s.get('axis')} {s.get('direction')} "
+                         f"shards {s.get('mismatch_shards')} "
+                         f"({s.get('mismatch_count')} words)")
+        lines.append(line)
+    return lines
+
+
+def _fmtv(v):
+    if isinstance(v, list):
+        return "[" + ",".join(f"{x:.4g}" if isinstance(x, (int, float))
+                              else str(x) for x in v[:4]) + \
+            ("…]" if len(v) > 4 else "]")
+    if isinstance(v, (int, float)):
+        return f"{v:.6g}"
+    return str(v)
+
+
 def _health_lines(status) -> list:
     hb = status.get("heartbeat") or {}
     chunk = status.get("latest_chunk") or {}
@@ -259,6 +319,7 @@ def run_frame(status, ledger_path) -> str:
     lines = _header_lines(status)
     lines += _throughput_lines(status)
     lines += _health_lines(status)
+    lines += _sim_health_lines(status)
     lines += _hosts_lines(status)
     lines += _campaign_lines(status, ledger_path)
     return "\n".join(lines)
@@ -333,12 +394,16 @@ def frame(source: str, ledger_path: str):
 
 def health_rc(status) -> int:
     """CI/campaign health probe verdict for ``--once``: nonzero when
-    the latest heartbeat verdict is WEDGED/STALLED, the supervisor gave
-    up, or — on an aggregate page — ANY host is in one of those states."""
+    the latest heartbeat verdict is WEDGED/STALLED, the numerics
+    sentinel says DIVERGED (same contract — a diverged run failed, in
+    the way that matters most), the supervisor gave up, or — on an
+    aggregate page — ANY host is in one of those states."""
     if not status:
         return 0
-    bad = ("WEDGED", "STALLED", "GAVE_UP")
+    bad = ("WEDGED", "STALLED", "GAVE_UP", "DIVERGED")
     if status.get("verdict") in bad or status.get("give_up"):
+        return 1
+    if (status.get("health") or {}).get("verdict") == "DIVERGED":
         return 1
     agg = status.get("aggregate") or {}
     if agg.get("verdict") in bad:

@@ -162,6 +162,82 @@ assert restart['ts'] + restart['dur'] <= attempts[1]['ts'], \
 print('span smoke ok: trace', tids.pop(), 'across', len(files),
       'logs,', len(spans), 'spans')
 " || rc=1
+# Health-sentinel smoke (obs/health.py, round 17): the E2E acceptance
+# pin.  FAULT_INJECT=numerics:step=40:nan under --supervise --serve 0
+# --health must (1) end DIVERGED in /status.json (scraped live from the
+# supervisor's aggregate console), (2) make the supervisor give up
+# WITHOUT a restart loop (give_up carrying the verdict, exactly one
+# launch, no restart event), and (3) land the ledger row quarantined
+# with reason 'diverged'.  obs_top --once on the child log must exit
+# nonzero (the DIVERGED health-probe contract).
+rm -rf /tmp/_t1_health
+timeout -k 10 300 env FAULT_INJECT='numerics:step=40:nan' python -c "
+import json, threading, time, urllib.request
+from cpuforce import force_cpu; force_cpu()
+from mpi_cuda_process_tpu import cli
+from mpi_cuda_process_tpu.obs import ledger
+from mpi_cuda_process_tpu.resilience import supervisor as sup
+tel = '/tmp/_t1_health/run.jsonl'
+seen = {}
+def scrape():
+    url = None
+    deadline = time.monotonic() + 120
+    suplog = sup.sibling_path(tel, 'supervisor')
+    while time.monotonic() < deadline and url is None:
+        try:
+            for line in open(suplog):
+                rec = json.loads(line)
+                if rec.get('kind') == 'serve':
+                    url = rec['url']
+        except (OSError, ValueError):
+            pass
+        if url is None:
+            time.sleep(0.05)
+    while time.monotonic() < deadline:
+        try:
+            s = json.load(urllib.request.urlopen(url + '/status.json',
+                                                 timeout=5))
+            seen['last'] = s
+            if s.get('verdict') == 'DIVERGED':
+                seen['diverged'] = s
+                return
+        except OSError:
+            pass
+        time.sleep(0.1)
+t = threading.Thread(target=scrape); t.start()
+rc = sup.run_supervised(cli.config_from_args(
+    ['--stencil', 'heat2d', '--grid', '64,64', '--iters', '100',
+     '--seed', '7', '--checkpoint-every', '10',
+     '--checkpoint-dir', '/tmp/_t1_health/ck', '--telemetry', tel,
+     '--health', '--supervise', '--max-restarts', '2',
+     '--restart-backoff', '0.3', '--supervise-stall-s', '60',
+     '--serve', '0']))
+t.join()
+assert rc == 1, f'supervisor rc={rc} (want give-up)'
+s = seen.get('diverged')
+assert s is not None, f'never saw DIVERGED in /status.json: {seen.get(\"last\", {}).get(\"verdict\")!r}'
+assert (s.get('health') or {}).get('verdict') == 'DIVERGED', s.get('health')
+evs = [json.loads(line)
+       for line in open(sup.sibling_path(tel, 'supervisor')) if line.strip()]
+kinds = [e.get('kind') for e in evs]
+assert 'restart' not in kinds, kinds
+assert len([e for e in evs if e.get('kind') == 'launch']) == 1, kinds
+gu = [e for e in evs if e.get('kind') == 'give_up']
+assert gu and gu[0].get('verdict') == 'DIVERGED', gu
+rows = ledger.rows_from_log(sup.sibling_path(tel, 'attempt0'))
+assert rows and rows[-1]['status'] == 'quarantined' \
+    and rows[-1]['quarantine'] == 'diverged', rows
+print('health smoke ok: DIVERGED in /status.json, give-up without'
+      ' restart, ledger row quarantined(diverged)')
+" || rc=1
+timeout -k 10 120 python scripts/obs_report.py \
+  /tmp/_t1_health/run.attempt0.jsonl --check > /dev/null || rc=1
+# obs_top --once exits NONZERO on the diverged child log (the same CI
+# probe contract as WEDGED/STALLED/give-up)
+if timeout -k 10 120 python scripts/obs_top.py \
+     /tmp/_t1_health/run.attempt0.jsonl --once > /dev/null; then
+  echo 'obs_top --once must exit nonzero on a DIVERGED log' >&2; rc=1
+fi
 # Live-console smoke (obs/serve.py): a CPU run with --serve 0 must
 # expose /metrics, /status.json, and an incremental /events?after=
 # slice over stdlib urllib WHILE the run is in flight (the scraper
