@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import driver
+from . import cancellation, driver
 from .config import RunConfig, parse_int_tuple, parse_params
 from .ops import stencil as stencil_lib
 from .ops import advection, heat, life, reaction, sor, wave  # noqa: F401  (populate the registry)
@@ -312,6 +312,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "was writing anyway: zero ops in the jitted "
                         "step, and endpoint handlers never touch the "
                         "run loop.  Shuts down with the run")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="JAX persistent compilation cache directory: "
+                        "compiled executables are written to DIR and "
+                        "reloaded on later runs, so a program shape "
+                        "this machine has EVER compiled (any process) "
+                        "skips the real XLA backend work.  The serving "
+                        "engine points every size-class build here so "
+                        "even a cold class almost never pays a cold "
+                        "compile.  Lifecycle-only: the cache changes "
+                        "when a run compiles, never what it computes")
+    p.add_argument("--serve-engine", type=int, default=None,
+                   metavar="PORT",
+                   help="resident serving engine (serving/): run this "
+                        "config as a job on a continuous-batching "
+                        "ServingEngine — size-classed resident compiled "
+                        "steps, budget-priced admission, weighted-FIFO "
+                        "fairness — with the scheduler console "
+                        "(/metrics /status.json /events: queue depth, "
+                        "slot occupancy, admission/evict/preempt "
+                        "counters) on PORT (0 = ephemeral).  One config "
+                        "is a degenerate workload; the flag exists as "
+                        "the quickstart face of the scheduler — "
+                        "multi-tenant traffic submits through "
+                        "serving.ServingEngine in-process")
     p.add_argument("--mem-check", default="error",
                    choices=["error", "warn", "off"],
                    help="per-device HBM budget guard (TPU runs): estimate "
@@ -345,6 +369,8 @@ def config_from_args(argv=None) -> RunConfig:
         restart_backoff=a.restart_backoff,
         supervise_stall_s=a.supervise_stall_s,
         serve_port=a.serve_port,
+        compile_cache=a.compile_cache,
+        serve_engine=a.serve_engine,
         params=parse_params(a.param),
     )
 
@@ -914,6 +940,43 @@ def _looks_like_pallas_failure(e: BaseException) -> bool:
         "vmem", "JaxRuntimeError", "XlaRuntimeError", "INTERNAL"))
 
 
+def enable_compile_cache(directory) -> bool:
+    """Point jax's persistent compilation cache at ``directory``.
+
+    Process-wide and idempotent (jax.config.update is last-write-wins).
+    The min-compile-time / min-entry-size floors are zeroed so even the
+    sub-second CPU test programs land in the cache — without that, a
+    tier-1 run would never exercise the read-back path at all.  Returns
+    whether the cache was enabled (best-effort: an old jax without the
+    knobs degrades to a warning, never a crash).
+    """
+    if not directory:
+        return False
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(directory))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+        except Exception:  # noqa: BLE001 — knob absent in older jax
+            pass
+        try:
+            # jax latches "no cache" at the first compile of the
+            # process; a long-lived engine enabling the cache AFTER
+            # some earlier compile must force re-initialization or the
+            # new directory is silently ignored
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # noqa: BLE001 — private hook; absent is fine
+            pass
+        return True
+    except Exception as e:  # noqa: BLE001 — cache is an optimization
+        log.warning("--compile-cache disabled (%s: %s)",
+                    type(e).__name__, e)
+        return False
+
+
 def _check_mem_budget(cfg: RunConfig) -> None:
     """Refuse-with-arithmetic HBM guard (TPU backends; utils/budget.py)."""
     if cfg.mem_check == "off" or jax.default_backend() != "tpu":
@@ -1013,6 +1076,12 @@ def _run_once(cfg: RunConfig) -> Tuple:
     server = _open_serve(cfg, session)
     try:
         return _run_measured(cfg, session)
+    except cancellation.RunCancelled as e:
+        # a cancel is a third terminal outcome, not an error: the log
+        # records a 'cancelled' event (ledger quarantines with reason
+        # 'cancelled'; the supervisor reads it as fatal-no-restart)
+        session.event("cancelled", step=e.step)
+        raise
     except BaseException as e:
         session.error(e)
         raise
@@ -1050,6 +1119,7 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
             "--halo-audit runs at chunk boundaries; --tol runs inside "
             "one while_loop with no boundary to audit at")
     _check_mem_budget(cfg)
+    enable_compile_cache(cfg.compile_cache)
     mesh_lib.bootstrap_distributed()
     build_t0, build_m0 = time.time(), time.perf_counter()
     st, step_fn, fields, start_step = build(cfg)
@@ -1170,6 +1240,12 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
 
     def callback(done_in_run, fs):
         step = start_step + done_in_run * max(1, cfg.fuse)
+        # Cooperative cancellation point (cancellation.py): the chunk
+        # boundary is the one place state is materialized and
+        # consistent, so a cancel lands here — before this boundary's
+        # checkpoint/diagnostics, ending the run as cleanly as reaching
+        # --iters would have.
+        cancellation.check(step)
         # Fault point (resilience/faults.py): the first chunk boundary
         # at/past the spec's step, BEFORE this boundary's checkpoint
         # save — a kill "at step 40" leaves step 30 as the newest
@@ -1340,6 +1416,10 @@ def main(argv=None) -> int:
         from .resilience import supervisor as supervisor_lib
 
         return supervisor_lib.run_supervised(cfg)
+    if cfg.serve_engine is not None:
+        from . import serving
+
+        return serving.serve_engine_main(cfg)
     run(cfg)
     return 0
 

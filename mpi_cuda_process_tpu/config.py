@@ -101,6 +101,19 @@ class RunConfig:
     # recorded as a 'serve' event).  Launcher-only: a supervised child
     # must never try to bind the parent's port, so to_argv drops it.
     serve_port: Optional[int] = None
+    # JAX persistent compilation cache directory (--compile-cache DIR):
+    # compiled executables are written to / reloaded from DIR, so a
+    # size class already seen by ANY prior process on this machine
+    # skips the real XLA backend work.  Lifecycle: the cache changes
+    # when a program compiles, never what it computes.
+    compile_cache: Optional[str] = None
+    # resident serving engine (serving/): --serve-engine PORT runs this
+    # config as a job on a continuous-batching ServingEngine with the
+    # scheduler console (queue depth, slot occupancy, admission/evict
+    # counters) served over HTTP on PORT (0 = ephemeral).  Launcher-
+    # only, like serve_port: a scheduler-launched child must run the
+    # one ordinary CLI path, never nest another scheduler.
+    serve_engine: Optional[int] = None
     params: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:
@@ -123,7 +136,7 @@ class RunConfig:
 # reason: the parent's console serves the child's log, and a child that
 # re-served would race the parent for the port.
 _ARGV_SKIP = frozenset({"supervise", "max_restarts", "restart_backoff",
-                        "supervise_stall_s", "serve_port"})
+                        "supervise_stall_s", "serve_port", "serve_engine"})
 
 
 # --------------------------------------------------------------------------
@@ -146,6 +159,7 @@ LIFECYCLE_FIELDS = frozenset({
     "dump_every", "dump_dir",
     "telemetry", "mem_check", "supervise", "max_restarts",
     "restart_backoff", "supervise_stall_s", "serve_port",
+    "compile_cache", "serve_engine",
 })
 
 SIM_FIELDS = frozenset(

@@ -80,11 +80,41 @@ class RunHandle:
         self._done = threading.Event()
         self._result: Optional[Tuple] = None
         self._error: Optional[BaseException] = None
+        # cooperative cancel token (cancellation.py): set by cancel(),
+        # polled by the CLI's chunk-boundary callback on the run thread
+        self._cancel = threading.Event()
 
     # -- lifecycle ------------------------------------------------------
 
     def done(self) -> bool:
         return self._done.is_set()
+
+    def cancel(self) -> bool:
+        """Request cooperative cancellation at the next chunk boundary.
+
+        Idempotent and race-free: a run that already finished ignores
+        it (returns False); a queued run cancels before its first step
+        (the token is checked at every boundary, boundary 0 included).
+        The cancelled run ends with a ``cancelled`` telemetry event,
+        phase ``"cancelled"``, and ``result()`` re-raising
+        :class:`cancellation.RunCancelled` — never an ``error`` row.
+        """
+        if self._done.is_set():
+            return False
+        self._cancel.set()
+        return True
+
+    def cancelled(self) -> bool:
+        from .cancellation import RunCancelled
+
+        return isinstance(self._error, RunCancelled)
+
+    def _phase(self) -> str:
+        if self.cancelled():
+            return "cancelled"
+        if self._error is not None:
+            return "failed"
+        return "done" if self._done.is_set() else "running"
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)
@@ -152,8 +182,7 @@ class RunHandle:
             "submitted_at": self.submitted_at,
             "telemetry": self.telemetry_path,
             "sim_signature": self.sim_signature,
-            "phase": ("failed" if self._error is not None else
-                      "done" if self._done.is_set() else "running"),
+            "phase": self._phase(),
         }
         if self.trace_id is not None:
             req["trace_id"] = self.trace_id
@@ -260,7 +289,7 @@ class SimulationEngine:
         return handle
 
     def _execute(self, handle: RunHandle) -> None:
-        from . import cli
+        from . import cancellation, cli
         from .obs import spans as spans_lib
 
         with self._run_lock:
@@ -270,7 +299,10 @@ class SimulationEngine:
             spans_lib.push_thread_context(spans_lib.SpanContext(
                 handle.trace_id, handle.request_span_id))
             try:
-                handle._result = cli.run(handle.config)
+                # the handle's cancel token rides the run thread; the
+                # CLI's chunk callback polls it (cancellation.check)
+                with cancellation.scope(handle._cancel):
+                    handle._result = cli.run(handle.config)
             except BaseException as e:  # noqa: BLE001 — delivered via
                 handle._error = e       # handle.result(), never lost
             finally:
@@ -309,7 +341,10 @@ class SimulationEngine:
         with self.metrics.lock:
             self.metrics.counter(
                 "engine_requests_total", "submitted runs completed").inc()
-            if handle._error is not None:
+            if handle.cancelled():
+                self.metrics.counter("engine_requests_cancelled_total",
+                                     "submitted runs cancelled").inc()
+            elif handle._error is not None:
                 self.metrics.counter("engine_requests_failed_total",
                                      "submitted runs that raised").inc()
             if queue_wait is not None:
@@ -359,8 +394,7 @@ class SimulationEngine:
         for h in self._handles:
             rows.append({
                 "id": h.id,
-                "phase": ("failed" if h._error is not None else
-                          "done" if h.done() else "running"),
+                "phase": h._phase(),
                 "ensemble": h.config.ensemble or None,
                 "telemetry": h.telemetry_path,
                 "submitted_at": h.submitted_at,

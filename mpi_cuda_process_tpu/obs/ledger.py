@@ -115,6 +115,7 @@ def baseline_key(row: Dict[str, Any]) -> str:
 
 def classify(value: Any, *, stale: bool = False, suspect: bool = False,
              error: Optional[str] = None,
+             cancelled: bool = False,
              backend: Optional[str] = None,
              expected_backend: Optional[str] = None,
              heartbeat: Optional[str] = None,
@@ -125,8 +126,13 @@ def classify(value: Any, *, stale: bool = False, suspect: bool = False,
     quarantines.  A value of 0.0 (the wedged scoreboards) is never a
     measurement.  A DIVERGED health verdict (obs/health.py) quarantines
     with reason ``diverged``: the throughput of a run computing garbage
-    is not a baseline candidate, however fast it looked.
+    is not a baseline candidate, however fast it looked.  A cancelled
+    run (cancellation.py) quarantines with reason ``cancelled`` —
+    checked before ``error`` so a deliberately stopped run can never
+    read as ``errored``.
     """
+    if cancelled:
+        return "quarantined", "cancelled"
     if error:
         return "quarantined", f"errored: {str(error)[:120]}"
     if stale:
@@ -156,11 +162,13 @@ def make_row(label: str, value: Any, *, source: str,
              detail: Optional[Dict[str, Any]] = None,
              stale: bool = False, suspect: bool = False,
              error: Optional[str] = None,
+             cancelled: bool = False,
              backend: Optional[str] = None,
              expected_backend: Optional[str] = None,
              **key_kw: Any) -> Dict[str, Any]:
     status, reason = classify(
         value, stale=stale, suspect=suspect, error=error,
+        cancelled=cancelled,
         backend=backend, expected_backend=expected_backend,
         heartbeat=heartbeat, health=health)
     key = make_key(label, backend=backend or expected_backend, **key_kw)
@@ -441,6 +449,13 @@ def rows_from_log(log_path: str) -> List[Dict[str, Any]]:
         if e.get("kind") == "resume" and \
                 e.get("resumed_from_step") is not None:
             resumed_from = e["resumed_from_step"]
+    # cooperative cancel (cancellation.py): the run wrote a 'cancelled'
+    # event instead of an error — its row must quarantine with reason
+    # 'cancelled', never 'errored: ...'
+    cancelled_ev = None
+    for e in events:
+        if e.get("kind") == "cancelled":
+            cancelled_ev = e
     if tool == "cli":
         summaries = [e for e in events if e.get("kind") == "summary"]
         for s in summaries:
@@ -454,6 +469,21 @@ def rows_from_log(log_path: str) -> List[Dict[str, Any]]:
                 flags=_flags(run), builder_rev=prov.get("builder_rev"),
                 detail={"resumed_from_step": resumed_from}
                 if resumed_from is not None else None))
+        if cancelled_ev is not None and not summaries:
+            # a cancelled run ends before its summary — the row still
+            # lands (value-less, quarantined 'cancelled') so the ledger
+            # records a deliberate stop, distinct from a crash
+            rows.append(make_row(
+                _cli_label(run), None, source=source,
+                measured_at=cancelled_ev.get("t"),
+                heartbeat=hb, health=health, cancelled=True,
+                expected_backend=prov.get("backend"),
+                provenance=_prov_subset(prov),
+                grid=run.get("grid"), mesh=run.get("mesh"),
+                kind=run.get("fuse_kind"), dtype=run.get("dtype"),
+                flags=_flags(run), builder_rev=prov.get("builder_rev"),
+                detail={"cancelled_at_step": cancelled_ev.get("step")}
+                if cancelled_ev.get("step") is not None else None))
         if health == "DIVERGED" and not summaries:
             # a diverged run aborts before its summary — the row still
             # lands (value-less, quarantined 'diverged') so the ledger

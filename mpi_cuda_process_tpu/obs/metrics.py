@@ -352,6 +352,13 @@ class RunMetrics:
         self.health: Optional[Dict[str, Any]] = None
         self.halo_audit: Optional[Dict[str, Any]] = None
         self.summary: Optional[Dict[str, Any]] = None
+        # cooperative cancel (cancellation.py): a third terminal state
+        # — neither summary nor error; the status verdict reports it
+        self.cancelled: Optional[Dict[str, Any]] = None
+        # serving-scheduler aggregate (serving/scheduler.py events):
+        # queue depth, slot occupancy, per-op and per-tenant counters —
+        # rendered under status()["scheduler"] and the obs_top panel
+        self.scheduler: Optional[Dict[str, Any]] = None
         self.launches: List[Dict[str, Any]] = []
         self.restarts: List[Dict[str, Any]] = []
         self.give_up: Optional[Dict[str, Any]] = None
@@ -675,6 +682,61 @@ class RunMetrics:
         self.errors.append(rec)
         self.registry.counter("obs_errors_total", "error events").inc()
 
+    def _on_cancelled(self, rec: Dict[str, Any]) -> None:
+        self.cancelled = rec
+        self.registry.counter("obs_run_cancelled_total",
+                              "cooperative run cancellations").inc()
+        self.registry.gauge(
+            "obs_run_cancelled",
+            "1 once the run was cancelled (not errored)").set(1.0)
+
+    # gauges a scheduler event may carry; each becomes an obs_sched_*
+    # gauge and a key of status()["scheduler"]
+    _SCHED_GAUGES = (
+        ("queue_depth", "jobs waiting for a member slot"),
+        ("slots_total", "member slots across resident size classes"),
+        ("slots_busy", "member slots currently running a job"),
+        ("classes", "resident size classes (compiled steps kept hot)"),
+    )
+
+    def _on_scheduler(self, rec: Dict[str, Any]) -> None:
+        """Fold one serving-scheduler event (serving/scheduler.py).
+
+        Every event carries an ``op`` (submit/admit/reject/join/retire/
+        evict/preempt/cancel/class_build) plus the scheduler's current
+        occupancy gauges; per-tenant ops are counted under the tenant's
+        name so starvation is visible from ``/status.json`` alone.
+        """
+        op = str(rec.get("op") or "event")
+        sched = self.scheduler
+        if sched is None:
+            sched = self.scheduler = {"counts": {}, "tenants": {}}
+        sched["counts"][op] = sched["counts"].get(op, 0) + 1
+        self.registry.counter(
+            f"obs_sched_{_prom_name(op)}_total",
+            f"scheduler '{op}' decisions").inc()
+        for g, help_text in self._SCHED_GAUGES:
+            v = rec.get(g)
+            if isinstance(v, (int, float)):
+                sched[g] = v
+                self.registry.gauge(f"obs_sched_{g}", help_text).set(v)
+        tenant = rec.get("tenant")
+        if isinstance(tenant, str) and tenant:
+            t = sched["tenants"].setdefault(tenant, {})
+            t[op] = t.get(op, 0) + 1
+            self.registry.gauge_family(
+                "obs_sched_tenant_ops",
+                "per-tenant scheduler decision counts").set(
+                t[op], tenant=tenant, op=op)
+        if op == "reject":
+            # structured admission refusal: the reason is the payload
+            sched["last_reject"] = {
+                "tenant": tenant, "reason": rec.get("reason"),
+                "size_class": rec.get("size_class"), "t": rec.get("t")}
+        sched["last_event"] = {
+            "op": op, "tenant": tenant, "job": rec.get("job"),
+            "size_class": rec.get("size_class"), "t": rec.get("t")}
+
     def _on_summary(self, rec: Dict[str, Any]) -> None:
         self.summary = rec
         self.registry.gauge("obs_run_complete",
@@ -740,6 +802,10 @@ class RunMetrics:
         with self.registry.lock:
             hb = self.heartbeat
             verdict = hb.get("verdict") if hb else None
+            if self.cancelled is not None and verdict is None:
+                # a deliberate stop, distinct from DONE and from any
+                # failure verdict (which all dominate it below)
+                verdict = "CANCELLED"
             if (self.health or {}).get("verdict") == "DIVERGED":
                 # correctness dominates liveness: a run that diverged
                 # is lost no matter what the heartbeat says
@@ -768,6 +834,10 @@ class RunMetrics:
             }
             if self.halo_audit is not None:
                 out["halo_audit"] = self.halo_audit
+            if self.cancelled is not None:
+                out["cancelled"] = self.cancelled
+            if self.scheduler is not None:
+                out["scheduler"] = self.scheduler
             if self.trace_id is not None:
                 out["trace_id"] = self.trace_id
             if self.time_to_first_chunk_s is not None:

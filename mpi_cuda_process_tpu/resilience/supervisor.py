@@ -214,6 +214,11 @@ def _classify_event(e, kill_verdicts, fatal_verdicts):
         return ("verdict", e.get("verdict"), str(e.get("detail", ""))[:300])
     if e.get("kind") == "health" and e.get("verdict") in fatal_verdicts:
         return ("fatal", e.get("verdict"), str(e.get("reason", ""))[:300])
+    if e.get("kind") == "cancelled":
+        # cooperative cancel (cancellation.py): a deliberately stopped
+        # child is not a crash — never restart it into the work someone
+        # just cancelled
+        return ("fatal", "CANCELLED", f"cancelled at step {e.get('step')}")
     return None
 
 

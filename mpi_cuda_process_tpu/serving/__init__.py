@@ -1,0 +1,37 @@
+"""Continuous-batching serving layer (ROADMAP item 1's scheduler).
+
+``engine.SimulationEngine`` (PR 10) made requests asynchronous but
+still compiles and runs one config at a time — every submit pays its
+own XLA compile and the mesh idles between jobs.  This package applies
+the continuous-batching discipline of LLM serving to the batched
+ensemble step:
+
+* :class:`~.sizeclass.SizeClass` — the compile identity of a job (its
+  simulation fields minus the per-job ones: seed/density/init/iters),
+  plus the member-capacity ladder.  The *member axis* is the padded
+  dimension: a resident step compiled for capacity C serves any 1..C
+  simultaneous jobs of the class with zero recompiles.  The spatial
+  grid is NEVER padded — that would change the physics and break the
+  bit-exact-vs-solo contract.
+* :class:`~.admission.AdmissionController` — budget.py pricing of the
+  class at target capacity BEFORE a job is accepted: reject with the
+  arithmetic, never OOM the mesh.
+* :class:`~.scheduler.ServingEngine` — the request queue + scheduler:
+  jobs join free member slots of a resident compiled step at chunk
+  boundaries and leave when done (the step never stops); weighted-FIFO
+  fairness with a starvation bound; checkpoint-based preemption;
+  per-slot DIVERGED eviction (PR 12's sentinel as the eviction
+  signal); per-job telemetry streams riding the obs/ vocabulary.
+"""
+
+from .admission import AdmissionController, AdmissionError
+from .scheduler import ServeHandle, ServingEngine, serve_engine_main
+from .sizeclass import (CLASS_FIELDS, PER_JOB_SIM_FIELDS, class_config,
+                        class_signature)
+
+__all__ = [
+    "AdmissionController", "AdmissionError",
+    "ServeHandle", "ServingEngine", "serve_engine_main",
+    "CLASS_FIELDS", "PER_JOB_SIM_FIELDS",
+    "class_config", "class_signature",
+]

@@ -1,0 +1,962 @@
+"""Continuous-batching scheduler: many tenants, one resident mesh.
+
+``engine.SimulationEngine`` (PR 10) runs one config at a time: every
+submit compiles its own program and the mesh idles between requests.
+This module keeps ONE compiled ensemble step per *size class* resident
+and treats its MEMBER axis as the slot pool: an arriving job joins a
+free member slot at the next chunk boundary, leaves at the boundary
+where it completes, and the step never stops while work remains — the
+continuous-batching discipline of LLM serving, applied to stencil
+simulation.
+
+Why this is sound: tests/test_ensemble_engine.py pins the batched
+(vmapped) step bit-identical to N independent solo runs per member, so
+a slot seeded with a job's own solo initial state computes exactly the
+job's solo trajectory — isolation is a *theorem* of the step, not a
+scheduler promise.  The spatial grid is never padded (that would
+change the physics); only the member count is, from a small fixed
+capacity ladder (default 1/2/4/8), each rung compiled once and kept
+resident so occupancy changes never recompile.
+
+Mechanics, per class thread, at every chunk boundary (the only place
+state materializes):
+
+* retire jobs whose remaining steps hit zero (extract the member's
+  solo fields, write the job's ``summary``, resolve its handle);
+* honor cooperative cancels (``RunHandle.cancel``: the job ends with a
+  ``cancelled`` event, never an ``error``);
+* evict diverged members: a per-slot non-finite sweep turns PR 12's
+  DIVERGED verdict into the eviction signal — the poisoned slot is
+  recycled, the other tenants never see it;
+* admit waiters into free slots (weighted FIFO: highest priority wins,
+  FIFO among equals, and any waiter older than ``starvation_rounds``
+  boundaries is served strictly FIFO ahead of priority — the
+  starvation bound);
+* preempt: when no slot is free and the class cannot grow, a starved
+  or higher-priority waiter checkpoints the lowest-priority runner out
+  (PR 8's npz checkpoint machinery); the victim re-queues and resumes
+  from its checkpoint, losing no completed chunk;
+* grow: re-build at the next ladder rung (budget-priced first) and
+  migrate occupied members — a one-time compile per rung, amortized
+  across every future job of the class.
+
+Chunk sizes are powers of two ≤ min(remaining over occupied slots,
+cadence), so each class needs at most log2(cadence)+1 distinct scan
+lengths — each a resident donated runner (``driver.make_runner``),
+compiled once.  Admission is priced by ``utils/budget.py`` BEFORE a
+job is accepted (reject with the arithmetic, never OOM), and every
+scheduling decision is emitted as a ``scheduler`` telemetry event that
+``obs/metrics.py`` folds into ``/status.json`` for the live console.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import cancellation
+from ..config import RunConfig
+from ..engine import RunHandle
+from .admission import AdmissionController, AdmissionError
+from .sizeclass import class_config, class_signature, ladder_rung, next_rung
+
+__all__ = ["ServeHandle", "ServingEngine", "serve_engine_main"]
+
+# config fields a slot-resident job cannot honor: launcher modes own a
+# process lifecycle, per-job checkpoint/dump/profile/render paths hook
+# the solo driver loop, and the tol/while_loop runner has no chunk
+# boundaries to batch at.  Predicate is truthiness of the field value.
+_UNSUPPORTED_FIELDS = (
+    "supervise", "serve_port", "serve_engine", "resume",
+    "checkpoint_every", "dump_every", "profile", "profile_dir",
+    "debug_checks", "halo_audit", "render", "tol",
+    "ensemble", "ensemble_mesh", "ensemble_perturb",
+)
+
+
+def _short_sig(sig: str) -> str:
+    return hashlib.sha1(sig.encode()).hexdigest()[:8]
+
+
+class ServeHandle(RunHandle):
+    """One tenant job riding a member slot of a resident size class.
+
+    Same face as :class:`~..engine.RunHandle` (``status``/``events``/
+    ``result``/``cancel``), plus the queue-resident phases: ``queued``
+    -> ``running`` (-> ``preempted`` -> ``running``) -> ``done`` |
+    ``cancelled`` | ``evicted`` | ``failed``.
+    """
+
+    def __init__(self, run_id: str, config: RunConfig,
+                 telemetry_path: str, tenant: str, priority: int,
+                 sig: str, seq: int, engine: "ServingEngine"):
+        super().__init__(run_id, config, telemetry_path)
+        self.tenant = tenant
+        self.priority = int(priority)
+        self.size_class = sig
+        self.class_label = _short_sig(sig)
+        self.seq = seq
+        self.unit = max(1, config.fuse)
+        self.cells = 1
+        for g in config.grid:
+            self.cells *= int(g)
+        self.remaining = int(config.iters)       # real steps left
+        self.steps_done = 0
+        self.active_wall_s = 0.0                 # wall while resident
+        self.slot: Optional[int] = None
+        self.enqueued_round: Optional[int] = None
+        self.preempt_ckpt: Optional[str] = None
+        self.preempt_count = 0
+        self.phase_live = "queued"
+        self.session = None                      # obs.Session, engine-owned
+        self._engine = engine
+
+    def cancel(self) -> bool:
+        """Cooperative cancel at the job's next boundary (a queued job
+        cancels before ever touching a slot).  Idempotent."""
+        if self._done.is_set():
+            return False
+        self._cancel.set()
+        eng = self._engine
+        if eng is not None:
+            with eng._cv:
+                eng._cv.notify_all()
+        return True
+
+    def _phase(self) -> str:
+        if self.cancelled():
+            return "cancelled"
+        if self._error is not None:
+            from ..obs.health import SimulationDiverged
+
+            return "evicted" if isinstance(self._error,
+                                           SimulationDiverged) else "failed"
+        if self._done.is_set():
+            return "done"
+        return self.phase_live
+
+
+class ResidentClass:
+    """One size class: a resident compiled step + its member slots.
+
+    Owns one daemon thread running the boundary loop; all shared state
+    is mutated under the engine's condition lock, device work under the
+    engine's step lock (one device set — classes interleave chunks, they
+    never overlap them).
+    """
+
+    def __init__(self, engine: "ServingEngine", sig: str,
+                 template: RunConfig, capacity: int):
+        self.engine = engine
+        self.sig = sig
+        self.label = _short_sig(sig)
+        # class fields only matter; per-job fields of the template are
+        # reset by class_config before any build
+        self.template = template
+        self.capacity = int(capacity)
+        self.unit = max(1, template.fuse)
+        self.cadence_units = max(1, engine.cadence // self.unit)
+        self.st = None
+        self.fields = None
+        self.runners: Dict[int, Any] = {}
+        self._warm: set = set()   # chunk lengths already run once
+        self._step_fn = None
+        self.slots: List[Optional[ServeHandle]] = []
+        self.rounds = 0          # boundary counter: the starvation clock
+        self.global_step = 0     # real steps advanced since first build
+        self.compiles = 0        # runner builds (distinct scan lengths)
+        self.dead: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"serve-class-{self.label}")
+
+    # -- build / grow ---------------------------------------------------
+
+    def _build(self, capacity: int) -> None:
+        """Compile the class step at ``capacity`` members (dummy ballast
+        state: every occupied slot is overwritten with its job's own
+        solo init before it computes anything a tenant sees)."""
+        from .. import cli
+
+        build_cfg = class_config(self.template, capacity)
+        with self.engine._step_lock:
+            st, step_fn, fields, _ = cli.build(build_cfg)
+        with self.engine._cv:
+            self.st = st
+            self._step_fn = step_fn
+            self.fields = fields
+            self.runners = {}
+            self._warm = set()
+            self.slots = [None] * capacity
+            self.capacity = capacity
+            self.engine._event("class_build", extra={
+                "size_class": self.label, "capacity": capacity})
+
+    def _runner(self, chunk_units: int):
+        """The resident donated runner for this scan length (compiled
+        on first use, reused for the life of the class/capacity)."""
+        from .. import driver
+
+        r = self.runners.get(chunk_units)
+        if r is None:
+            r = driver.make_runner(self._step_fn, chunk_units)
+            self.runners[chunk_units] = r
+            self.compiles += 1
+        return r
+
+    def _grow(self, capacity: int) -> None:
+        """Re-build at the next rung and migrate occupied members.
+
+        The one scheduled event that DOES compile — once per rung per
+        class, priced by admission before it is attempted."""
+        from .. import cli
+
+        build_cfg = class_config(self.template, capacity)
+        with self.engine._step_lock:
+            _, step_fn, fields, _ = cli.build(build_cfg)
+        with self.engine._cv:
+            for i, j in enumerate(self.slots):
+                if j is not None:
+                    fields = tuple(nf.at[i].set(f[i])
+                                   for nf, f in zip(fields, self.fields))
+            self._step_fn = step_fn
+            self.fields = fields
+            self.runners = {}
+            self._warm = set()
+            self.slots = self.slots + [None] * (capacity - self.capacity)
+            self.capacity = capacity
+            self.cadence_units = max(1, self.engine.cadence // self.unit)
+            self.engine._event("grow", extra={
+                "size_class": self.label, "capacity": capacity})
+            self.engine._cv.notify_all()
+
+    # -- scheduling (all *_locked under engine._cv) ---------------------
+
+    def _waiters_locked(self) -> List[ServeHandle]:
+        return [j for j in self.engine._waiting if j.size_class == self.sig]
+
+    def _occupied_locked(self) -> List[ServeHandle]:
+        return [j for j in self.slots if j is not None]
+
+    def _pick_locked(self, waiters: List[ServeHandle]) -> ServeHandle:
+        """Weighted FIFO with a starvation bound: any waiter older than
+        ``starvation_rounds`` boundaries is served strictly FIFO ahead
+        of priority; otherwise highest priority, FIFO among equals."""
+        starved = [j for j in waiters
+                   if j.enqueued_round is not None
+                   and self.rounds - j.enqueued_round
+                   >= self.engine.starvation_rounds]
+        if starved:
+            return min(starved, key=lambda j: j.seq)
+        return max(waiters, key=lambda j: (j.priority, -j.seq))
+
+    def _can_grow_locked(self) -> Optional[int]:
+        nxt = next_rung(self.engine.ladder, self.capacity)
+        if nxt == self.capacity:
+            return None
+        try:
+            est = self.engine.admission.price(
+                class_config(self.template, nxt))
+        except Exception:  # noqa: BLE001 — unpriceable => don't grow
+            return None
+        return nxt if est["total_bytes"] <= est["hbm_bytes"] else None
+
+    def _maybe_preempt_locked(self, waiters: List[ServeHandle]) -> None:
+        """Checkpoint the lowest-priority runner out for a strictly
+        stronger waiter (a starved waiter is strictly stronger than
+        anyone — the bound guarantees it a slot, and hence at least one
+        chunk of progress, every ~starvation_rounds boundaries)."""
+        starved = [j for j in waiters
+                   if j.enqueued_round is not None
+                   and self.rounds - j.enqueued_round
+                   >= self.engine.starvation_rounds]
+        if starved:
+            challenger_pri = float("inf")
+        else:
+            challenger_pri = max(j.priority for j in waiters)
+        victims = [j for j in self.slots
+                   if j is not None and j.steps_done > 0]
+        if not victims:
+            return
+        victim = min(victims, key=lambda j: (j.priority, -j.seq))
+        if challenger_pri <= victim.priority:
+            return
+        self._preempt_locked(victim)
+
+    def _preempt_locked(self, j: ServeHandle) -> None:
+        from ..utils import checkpointing
+
+        i = j.slot
+        solo = self._extract_locked(i)
+        os.makedirs(self.engine._spool, exist_ok=True)
+        path = os.path.join(self.engine._spool,
+                            f"{j.id}-{j.preempt_count}.npz")
+        checkpointing.save_checkpoint(path, solo, j.steps_done,
+                                      dataclasses.asdict(j.config))
+        j.preempt_ckpt = path
+        j.preempt_count += 1
+        self.slots[i] = None
+        j.slot = None
+        j.phase_live = "preempted"
+        j.enqueued_round = None      # ages afresh from re-queue
+        self.engine._waiting.append(j)
+        self.engine._event("preempt", job=j,
+                           extra={"checkpoint": path,
+                                  "at_step": j.steps_done})
+
+    def _place_locked(self, j: ServeHandle, i: int) -> None:
+        """Seed slot ``i`` with the job's own solo state: its solo init
+        (bit-identical to a fresh solo run's) or its preemption
+        checkpoint (resume where it left off)."""
+        import jax.numpy as jnp
+
+        from ..utils import checkpointing
+        from ..utils.init import init_state
+
+        if j.preempt_ckpt is not None:
+            loaded, _, _ = checkpointing.load_checkpoint(j.preempt_ckpt)
+            solo = loaded
+        else:
+            solo = init_state(self.st, j.config.grid, seed=j.config.seed,
+                              density=j.config.density, kind=j.config.init,
+                              periodic=j.config.periodic)
+        self.fields = tuple(
+            f.at[i].set(jnp.asarray(s, f.dtype))
+            for f, s in zip(self.fields, solo))
+        self.slots[i] = j
+        j.slot = i
+        j.phase_live = "running"
+        if j.started_at is None:
+            j.started_at = time.time()
+        self.engine._event("join", job=j,
+                           extra={"slot": i,
+                                  "resumed_at_step":
+                                      j.steps_done or None})
+
+    def _admit_locked(self) -> None:
+        self.rounds += 1
+        waiters = self._waiters_locked()
+        for j in waiters:
+            if j.enqueued_round is None:
+                j.enqueued_round = self.rounds
+        if not waiters:
+            return
+        free = [i for i, s in enumerate(self.slots) if s is None]
+        if not free and self._can_grow_locked() is None:
+            self._maybe_preempt_locked(waiters)
+            free = [i for i, s in enumerate(self.slots) if s is None]
+        while free and waiters:
+            j = self._pick_locked(waiters)
+            waiters.remove(j)
+            self.engine._waiting.remove(j)
+            self._place_locked(j, free.pop(0))
+
+    def _pick_chunk_locked(self, active: List[ServeHandle]) -> int:
+        """Largest power of two ≤ min(remaining over occupied, cadence),
+        in call units — so retire lands exactly on a boundary and the
+        class needs ≤ log2(cadence)+1 distinct compiled scan lengths."""
+        rem = min(max(1, j.remaining // self.unit) for j in active)
+        c = min(self.cadence_units, rem)
+        return 1 << (c.bit_length() - 1)
+
+    # -- boundary outcomes ----------------------------------------------
+
+    def _extract_locked(self, i: int) -> Tuple:
+        import numpy as np
+
+        return tuple(np.asarray(f[i]) for f in self.fields)
+
+    def _scrub_locked(self, i: int) -> None:
+        """Overwrite a vacated slot with finite ballast so the
+        non-finite sweep never re-flags a retired/evicted member."""
+        import jax.numpy as jnp
+
+        self.fields = tuple(
+            f.at[i].set(jnp.zeros(f.shape[1:], f.dtype))
+            for f in self.fields)
+
+    def _finalize_locked(self, j: ServeHandle) -> None:
+        j.finished_at = time.time()
+        j.timings["latency_s"] = round(j.finished_at - j.submitted_at, 6)
+        j._done.set()
+
+    def _retire_locked(self, j: ServeHandle) -> None:
+        i = j.slot
+        solo = self._extract_locked(i)
+        self.slots[i] = None
+        j.slot = None
+        self._scrub_locked(i)
+        mcells = (j.cells * j.steps_done / j.active_wall_s / 1e6
+                  if j.active_wall_s > 0 else 0.0)
+        j._result = (solo, mcells)
+        try:
+            j.session.finish(steps=j.steps_done,
+                             mcells_per_s=round(mcells, 3))
+            j.session.close()
+        except Exception:  # noqa: BLE001 — telemetry never load-bearing
+            pass
+        self._finalize_locked(j)
+        eng = self.engine
+        eng._jobs_done += 1
+        ttfc = j.timings.get("time_to_first_chunk_s")
+        with eng.metrics.lock:
+            eng.metrics.counter("serve_jobs_done_total",
+                                "jobs retired complete").inc()
+            eng.metrics.histogram(
+                "serve_request_latency_s",
+                "submit -> retire end-to-end").observe(
+                j.timings["latency_s"])
+            if ttfc is not None:
+                eng.metrics.histogram(
+                    "serve_time_to_first_chunk_s",
+                    "submit -> first completed chunk (the serving "
+                    "SLO)").observe(ttfc)
+        eng._event("retire", job=j, extra={"steps": j.steps_done})
+
+    def _evict_locked(self, j: ServeHandle, nonfinite: int) -> None:
+        """PR 12's DIVERGED verdict as the eviction signal: the job's
+        log gets a real ``health`` record (so ``health_verdict()`` and
+        ``/status.json`` read DIVERGED), the slot is scrubbed and
+        recycled, the other tenants never see the poison."""
+        from ..obs.health import SimulationDiverged
+
+        i = j.slot
+        self.slots[i] = None
+        j.slot = None
+        self._scrub_locked(i)
+        reason = (f"{nonfinite} non-finite values in member slot {i} "
+                  f"at step {j.steps_done}")
+        err = SimulationDiverged(f"job {j.id} diverged: {reason}")
+        j._error = err
+        try:
+            j.session.event("health", step=j.steps_done,
+                            verdict="DIVERGED", nonfinite_total=nonfinite,
+                            reason=reason, checked="slot_sweep")
+            j.session.error(err)
+            j.session.close()
+        except Exception:  # noqa: BLE001
+            pass
+        self._finalize_locked(j)
+        self.engine._jobs_evicted += 1
+        self.engine.metrics.counter("serve_jobs_evicted_total",
+                                    "jobs evicted DIVERGED").inc()
+        self.engine._event("evict", job=j,
+                           extra={"reason": reason, "slot": i})
+
+    def _cancel_job_locked(self, j: ServeHandle) -> None:
+        if j.slot is not None:
+            i = j.slot
+            self.slots[i] = None
+            j.slot = None
+            self._scrub_locked(i)
+        j._error = cancellation.RunCancelled(j.steps_done)
+        try:
+            j.session.event("cancelled", step=j.steps_done)
+            j.session.close()
+        except Exception:  # noqa: BLE001
+            pass
+        self._finalize_locked(j)
+        self.engine._jobs_cancelled += 1
+        self.engine.metrics.counter("serve_jobs_cancelled_total",
+                                    "jobs cancelled").inc()
+        self.engine._event("cancel", job=j,
+                           extra={"at_step": j.steps_done})
+
+    def _reap_cancelled_waiters_locked(self) -> None:
+        for j in self._waiters_locked():
+            if j._cancel.is_set():
+                self.engine._waiting.remove(j)
+                self._cancel_job_locked(j)
+
+    def _fail_active_locked(self, e: BaseException) -> None:
+        for j in list(self.slots):
+            if j is None:
+                continue
+            i = j.slot
+            self.slots[i] = None
+            j.slot = None
+            j._error = e
+            try:
+                j.session.error(e)
+                j.session.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._finalize_locked(j)
+            self.engine._event("fail", job=j,
+                               extra={"error": f"{type(e).__name__}: "
+                                               f"{e}"[:300]})
+
+    def _slot_nonfinite(self):
+        """Per-member non-finite counts over the inexact fields (None
+        when the class has none — integer stencils cannot diverge)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        idx = [k for k, f in enumerate(self.fields)
+               if jnp.issubdtype(f.dtype, jnp.inexact)]
+        if not idx:
+            return None
+        total = None
+        for k in idx:
+            f = self.fields[k]
+            c = jnp.sum(~jnp.isfinite(f),
+                        axis=tuple(range(1, f.ndim)))
+            total = c if total is None else total + c
+        return np.asarray(total)
+
+    def _after_chunk_locked(self, active: List[ServeHandle],
+                            chunk_units: int, dt: float,
+                            warm: bool) -> None:
+        from ..resilience import faults
+        from ..obs import health as health_lib
+
+        real = chunk_units * self.unit
+        self.global_step += real
+        eng = self.engine
+        cell_steps = float(sum(j.cells for j in active)) * real
+        eng.total_cell_steps += cell_steps
+        eng.busy_wall_s += dt
+        if warm:
+            # steady-state aggregate: a runner's first invocation pays
+            # its (one-time) compile and must not read as throughput
+            eng.steady_cell_steps += cell_steps
+            eng.steady_wall_s += dt
+        now = time.time()
+        for j in active:
+            j.remaining -= real
+            j.steps_done += real
+            j.active_wall_s += dt
+            try:
+                j.session.recorder.record_chunk(chunk_units, dt)
+            except Exception:  # noqa: BLE001
+                pass
+            if j.timings.get("time_to_first_chunk_s") is None:
+                ttfc = now - j.submitted_at
+                j.timings["time_to_first_chunk_s"] = round(ttfc, 6)
+                eng._ttfc.append(ttfc)
+        # fault point (resilience/faults.py numerics site): poison ONE
+        # member slot, exactly like a real mid-run bit flip — the
+        # sweep below must catch it and evict only that tenant
+        if faults.injected_numeric_poison(self.global_step) is not None:
+            occ = [i for i, s in enumerate(self.slots) if s is not None]
+            if occ:
+                import jax.numpy as jnp
+
+                i = occ[0]
+                solo = tuple(jnp.asarray(a)
+                             for a in self._extract_locked(i))
+                poisoned = health_lib.apply_nan_poison(solo)
+                self.fields = tuple(
+                    f.at[i].set(p)
+                    for f, p in zip(self.fields, poisoned))
+        counts = self._slot_nonfinite()
+        if counts is not None:
+            for i, j in enumerate(list(self.slots)):
+                if j is not None and int(counts[i]) > 0:
+                    self._evict_locked(j, int(counts[i]))
+        for j in list(self.slots):
+            if j is not None and j._cancel.is_set():
+                self._cancel_job_locked(j)
+        for j in list(self.slots):
+            if j is not None and j.remaining <= 0:
+                self._retire_locked(j)
+
+    # -- the loop -------------------------------------------------------
+
+    def _loop(self) -> None:
+        import jax
+
+        eng = self.engine
+        try:
+            self._build(self.capacity)
+        except BaseException as e:  # noqa: BLE001 — fail queued jobs
+            with eng._cv:
+                self.dead = e
+                for j in self._waiters_locked():
+                    eng._waiting.remove(j)
+                    j._error = e
+                    try:
+                        j.session.error(e)
+                        j.session.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._finalize_locked(j)
+                eng._cv.notify_all()
+            return
+        while True:
+            with eng._cv:
+                self._reap_cancelled_waiters_locked()
+                self._admit_locked()
+                active = self._occupied_locked()
+                grow_to = None
+                if self._waiters_locked() and not any(
+                        s is None for s in self.slots):
+                    grow_to = self._can_grow_locked()
+                if not active and grow_to is None:
+                    if eng._closing and not self._waiters_locked():
+                        return
+                    eng._cv.wait(0.25)
+                    continue
+                if grow_to is None:
+                    chunk_units = self._pick_chunk_locked(active)
+                    for j in active:
+                        try:
+                            j.session.recorder.begin_chunk()
+                        except Exception:  # noqa: BLE001
+                            pass
+            if grow_to is not None:
+                try:
+                    self._grow(grow_to)
+                except BaseException:  # noqa: BLE001 — rung stays; jobs
+                    pass               # keep running at current capacity
+                continue
+            try:
+                warm = chunk_units in self._warm
+                runner = self._runner(chunk_units)
+                with eng._step_lock:
+                    t0 = time.perf_counter()
+                    self.fields = runner(self.fields)
+                    jax.block_until_ready(self.fields)
+                    dt = time.perf_counter() - t0
+                self._warm.add(chunk_units)
+            except BaseException as e:  # noqa: BLE001 — a chunk crash
+                with eng._cv:           # fails ITS tenants, not the pool
+                    self._fail_active_locked(e)
+                    eng._cv.notify_all()
+                continue
+            with eng._cv:
+                self._after_chunk_locked(active, chunk_units, dt, warm)
+                eng._cv.notify_all()
+
+
+class ServingEngine:
+    """The serving front-end: ``submit(cfg, tenant=, priority=)``.
+
+    One engine owns one device set: per-class boundary loops interleave
+    chunks under a shared step lock (device work is serialized; the
+    *slots* are what run concurrently).  All telemetry rides the obs/
+    vocabulary: the engine's own log streams ``scheduler`` events
+    (``serve(port)`` puts ``/status.json`` on it), and every job gets a
+    standard per-run log an ``obs_top`` or ``/events`` long-poll can
+    watch like any solo run.
+    """
+
+    _ids = itertools.count()
+
+    def __init__(self, telemetry_dir: Optional[str] = None,
+                 ladder: Tuple[int, ...] = (1, 2, 4, 8),
+                 cadence: int = 32, starvation_rounds: int = 4,
+                 compile_cache: Optional[str] = None,
+                 hbm_bytes: Optional[int] = None):
+        from .. import obs
+        from ..obs import trace as trace_lib
+        from ..obs.metrics import MetricsRegistry
+
+        ladder = tuple(sorted({int(c) for c in ladder}))
+        if not ladder or ladder[0] < 1:
+            raise ValueError(f"ladder must be positive capacities, "
+                             f"got {ladder!r}")
+        self.ladder = ladder
+        self.cadence = int(cadence)
+        self.starvation_rounds = int(starvation_rounds)
+        self.admission = AdmissionController(hbm_bytes=hbm_bytes)
+        self.compile_cache = compile_cache
+        if compile_cache:
+            from .. import cli
+
+            cli.enable_compile_cache(compile_cache)
+        self.telemetry_dir = telemetry_dir or \
+            trace_lib.default_telemetry_dir()
+        os.makedirs(self.telemetry_dir, exist_ok=True)
+        self._spool = os.path.join(self.telemetry_dir,
+                                   f"serve-spool-{os.getpid()}")
+        self._cv = threading.Condition(threading.RLock())
+        self._step_lock = threading.Lock()
+        self._waiting: List[ServeHandle] = []
+        self._classes: Dict[str, ResidentClass] = {}
+        self._handles: List[ServeHandle] = []
+        self._closing = False
+        self._seq = itertools.count()
+        self.metrics = MetricsRegistry()
+        self.total_cell_steps = 0.0
+        self.busy_wall_s = 0.0
+        self.steady_cell_steps = 0.0
+        self.steady_wall_s = 0.0
+        self._ttfc: List[float] = []
+        self._jobs_done = 0
+        self._jobs_cancelled = 0
+        self._jobs_evicted = 0
+        self._rejects = 0
+        self._ops: Dict[str, int] = {}
+        self._server = None
+        self.telemetry_path = os.path.join(
+            self.telemetry_dir,
+            f"serving-{os.getpid()}-{int(time.time() * 1e3)}-"
+            f"{next(self._ids)}.jsonl")
+        self._session = obs.open_session(
+            self.telemetry_path, tool="serving",
+            run={"ladder": list(self.ladder), "cadence": self.cadence,
+                 "starvation_rounds": self.starvation_rounds,
+                 "compile_cache": compile_cache},
+            with_heartbeat=False)
+
+    # -- telemetry ------------------------------------------------------
+
+    def _gauges_locked(self) -> Dict[str, int]:
+        return {
+            "queue_depth": len(self._waiting),
+            "slots_total": sum(len(c.slots)
+                               for c in self._classes.values()),
+            "slots_busy": sum(1 for c in self._classes.values()
+                              for s in c.slots if s is not None),
+            "classes": len(self._classes),
+        }
+
+    def _event(self, op: str, job: Optional[ServeHandle] = None,
+               extra: Optional[Dict[str, Any]] = None) -> None:
+        """One scheduling decision -> one ``scheduler`` record (the
+        stream ``obs/metrics.RunMetrics._on_scheduler`` folds into
+        ``/status.json`` and the ``obs_top`` scheduler panel)."""
+        with self._cv:
+            self._ops[op] = self._ops.get(op, 0) + 1
+            payload: Dict[str, Any] = {"op": op}
+            payload.update(self._gauges_locked())
+            if job is not None:
+                payload.update(tenant=job.tenant, job=job.id,
+                               size_class=job.class_label,
+                               priority=job.priority)
+            if extra:
+                payload.update(extra)
+            try:
+                self._session.event("scheduler", **payload)
+            except Exception:  # noqa: BLE001 — never load-bearing
+                pass
+
+    # -- submission -----------------------------------------------------
+
+    def _validate(self, cfg: RunConfig) -> None:
+        for name in _UNSUPPORTED_FIELDS:
+            if getattr(cfg, name):
+                raise AdmissionError(
+                    "unsupported",
+                    f"--{name.replace('_', '-')} cannot ride a shared "
+                    f"resident step (got {getattr(cfg, name)!r}); run "
+                    f"it solo via cli/engine",
+                    detail={"field": name, "value": getattr(cfg, name)})
+        unit = max(1, cfg.fuse)
+        if cfg.iters <= 0 or cfg.iters % unit:
+            raise AdmissionError(
+                "unsupported",
+                f"iters must be a positive multiple of the call unit "
+                f"{unit} (got {cfg.iters}) — jobs join and leave at "
+                f"chunk boundaries",
+                detail={"field": "iters", "value": cfg.iters,
+                        "unit": unit})
+
+    def submit(self, cfg: RunConfig, tenant: str = "default",
+               priority: int = 1) -> ServeHandle:
+        """Admit a job into its size class (or reject with the reason).
+
+        Pricing happens BEFORE acceptance, against the class at the
+        capacity the job would actually join — an accepted job can
+        always be placed; an impossible one is refused here with the
+        budget arithmetic, never discovered by an OOM mid-flight.
+        """
+        import dataclasses as _dc
+
+        from .. import obs
+        from ..obs import spans as spans_lib
+
+        with self._cv:
+            if self._closing:
+                raise RuntimeError("ServingEngine is closed")
+            sig = class_signature(cfg)
+            rc = self._classes.get(sig)
+            try:
+                self._validate(cfg)
+                if rc is not None and rc.dead is not None:
+                    raise AdmissionError(
+                        "unsupported",
+                        f"size class {_short_sig(sig)} failed to "
+                        f"build: {rc.dead}",
+                        detail={"size_class": _short_sig(sig)})
+                target = rc.capacity if rc is not None \
+                    else ladder_rung(self.ladder, 1)
+                est = self.admission.admit_or_raise(
+                    class_config(cfg, target))
+            except AdmissionError as e:
+                self._rejects += 1
+                self.metrics.counter("serve_rejects_total",
+                                     "jobs refused at admission").inc()
+                self._event("reject", extra={
+                    "tenant": tenant, "reason": e.reason,
+                    "size_class": _short_sig(sig),
+                    "message": str(e)})
+                raise
+            seq = next(self._seq)
+            path = cfg.telemetry or os.path.join(
+                self.telemetry_dir,
+                f"serve-{os.getpid()}-{seq}.jsonl")
+            j = ServeHandle(f"job-{os.getpid()}-{seq}", cfg, path,
+                            tenant, priority, sig, seq, self)
+            j.trace_id = spans_lib.new_id()
+            j.session = obs.open_session(
+                path, tool="serving", run=_dc.asdict(cfg),
+                step_unit=j.unit, with_heartbeat=False,
+                serving={"job": j.id, "tenant": tenant,
+                         "priority": j.priority,
+                         "size_class": j.class_label,
+                         "priced_bytes": est["total_bytes"],
+                         "hbm_bytes": est["hbm_bytes"]})
+            self._handles.append(j)
+            self._waiting.append(j)
+            if rc is None:
+                rc = ResidentClass(self, sig, cfg,
+                                   ladder_rung(self.ladder, 1))
+                self._classes[sig] = rc
+                rc._thread.start()
+            self._event("submit", job=j)
+            self._cv.notify_all()
+            return j
+
+    # -- introspection --------------------------------------------------
+
+    def handles(self) -> List[ServeHandle]:
+        return list(self._handles)
+
+    def request_stats(self) -> Dict[str, Any]:
+        """The serving SLOs: TTFC percentiles, aggregate throughput,
+        outcome counts — the numbers the load test pins and ``close``
+        writes into the scheduler log's summary."""
+        from ..obs.metrics import quantile
+
+        with self._cv:
+            ttfc = sorted(self._ttfc)
+            # steady-state aggregate (cold first calls excluded) when
+            # any warm chunk ran; the all-in number otherwise
+            if self.steady_wall_s > 0:
+                agg = self.steady_cell_steps / self.steady_wall_s / 1e9
+            elif self.busy_wall_s > 0:
+                agg = self.total_cell_steps / self.busy_wall_s / 1e9
+            else:
+                agg = None
+            out: Dict[str, Any] = {
+                "jobs_submitted": len(self._handles),
+                "jobs_done": self._jobs_done,
+                "jobs_cancelled": self._jobs_cancelled,
+                "jobs_evicted": self._jobs_evicted,
+                "rejects": self._rejects,
+                "preemptions": self._ops.get("preempt", 0),
+                "grows": self._ops.get("grow", 0),
+                "ttfc_p50_s": round(quantile(ttfc, 0.5), 6)
+                if ttfc else None,
+                "ttfc_p99_s": round(quantile(ttfc, 0.99), 6)
+                if ttfc else None,
+                "aggregate_gcells_per_s": round(agg, 6)
+                if agg is not None else None,
+                "busy_wall_s": round(self.busy_wall_s, 6),
+                "steady_wall_s": round(self.steady_wall_s, 6),
+            }
+            out.update(self._gauges_locked())
+            out["class_table"] = [
+                {"size_class": c.label, "capacity": c.capacity,
+                 "occupied": len(c._occupied_locked()),
+                 "rounds": c.rounds, "compiles": c.compiles,
+                 "steps": c.global_step}
+                for c in self._classes.values()]
+            return out
+
+    def status(self) -> Dict[str, Any]:
+        """Engine-level summary: the stats block plus one row per job
+        (the campaign-console shape)."""
+        out = self.request_stats()
+        with self._cv:
+            out["jobs"] = [
+                {"id": j.id, "tenant": j.tenant, "priority": j.priority,
+                 "phase": j._phase(), "size_class": j.class_label,
+                 "steps_done": j.steps_done, "remaining": j.remaining,
+                 "slot": j.slot, "telemetry": j.telemetry_path}
+                for j in self._handles]
+        return out
+
+    def serve(self, port: int = 0):
+        """Live HTTP console on the scheduler's own event stream
+        (``/status.json`` carries the scheduler block via
+        ``RunMetrics._on_scheduler``)."""
+        from ..obs import serve as serve_lib
+
+        self._server = serve_lib.serve_run(self.telemetry_path,
+                                           port=port)
+        return self._server
+
+    # -- shutdown -------------------------------------------------------
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = 120.0) -> Dict[str, Any]:
+        """Stop accepting, run down the queue (or cancel it), write the
+        serving summary, return the final stats."""
+        with self._cv:
+            self._closing = True
+            if not drain:
+                for j in self._handles:
+                    if not j.done():
+                        j._cancel.set()
+            self._cv.notify_all()
+        for rc in list(self._classes.values()):
+            rc._thread.join(timeout)
+        stats = self.request_stats()
+        try:
+            self._session.finish(**{
+                k: v for k, v in stats.items() if k != "class_table"})
+            self._session.close()
+        except Exception:  # noqa: BLE001
+            pass
+        if self._server is not None:
+            try:
+                self._server.close()
+            except Exception:  # noqa: BLE001
+                pass
+        return stats
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+
+def serve_engine_main(cfg: RunConfig) -> int:
+    """The ``--serve-engine PORT`` entry point: start a resident engine
+    with the live console attached, run the command-line config as its
+    first tenant, report, drain, exit.  (Long-lived multi-tenant use is
+    the programmatic API: ``ServingEngine.submit`` from any thread.)"""
+    import dataclasses as _dc
+
+    eng = ServingEngine(compile_cache=cfg.compile_cache,
+                        telemetry_dir=(os.path.dirname(cfg.telemetry)
+                                       if cfg.telemetry else None))
+    srv = eng.serve(cfg.serve_engine)
+    print(f"[serve-engine] scheduler console on {srv.url} "
+          f"(/status.json, /metrics, /events)", flush=True)
+    job_cfg = _dc.replace(cfg, serve_engine=None, compile_cache=None)
+    code = 0
+    try:
+        h = eng.submit(job_cfg)
+        _, mcells = h.result()
+        print(f"[serve-engine] {h.id} done: {mcells:.1f} Mcells/s "
+              f"(per member)", flush=True)
+    except BaseException as e:  # noqa: BLE001 — CLI boundary
+        print(f"[serve-engine] job failed: {type(e).__name__}: {e}",
+              flush=True)
+        code = 1
+    stats = eng.close()
+    print(f"[serve-engine] served {stats['jobs_done']} job(s), "
+          f"ttfc_p50={stats['ttfc_p50_s']}s "
+          f"aggregate={stats['aggregate_gcells_per_s']} Gcells/s",
+          flush=True)
+    return code

@@ -289,6 +289,48 @@ def _campaign_lines(status, ledger_path) -> list:
                           "best_known", "delta", "error"])]
 
 
+def _scheduler_lines(status) -> list:
+    """Serving-scheduler panel (serving/scheduler.py event stream):
+    occupancy gauges, decision counts, per-tenant ops, last reject."""
+    sched = status.get("scheduler")
+    if not sched:
+        return []
+    bits = []
+    for k in ("queue_depth", "slots_busy", "slots_total", "classes"):
+        if sched.get(k) is not None:
+            bits.append(f"{k}={sched[k]}")
+    counts = sched.get("counts") or {}
+    for op in ("submit", "retire", "reject", "evict", "preempt",
+               "cancel", "grow"):
+        if counts.get(op):
+            bits.append(f"{op}={counts[op]}")
+    lines = ["sched   " + "  ".join(bits)]
+    last = sched.get("last_event") or {}
+    if last:
+        lines.append(f"        last: {last.get('op', '?')} "
+                     f"tenant={last.get('tenant') or '-'} "
+                     f"job={last.get('job') or '-'} "
+                     f"class={last.get('size_class') or '-'} "
+                     f"({_age(last.get('t'))})")
+    rej = sched.get("last_reject")
+    if rej:
+        lines.append(f"        reject: tenant={rej.get('tenant') or '-'} "
+                     f"reason={rej.get('reason') or '?'} "
+                     f"class={rej.get('size_class') or '-'}")
+    tenants = sched.get("tenants") or {}
+    if tenants:
+        rows = []
+        for name in sorted(tenants):
+            t = tenants[name]
+            rows.append([name] + [t.get(op, 0) for op in
+                                  ("submit", "join", "retire", "evict",
+                                   "preempt", "cancel", "reject")])
+        lines.append(_table(rows, ["tenant", "submit", "join", "retire",
+                                   "evict", "preempt", "cancel",
+                                   "reject"]))
+    return lines
+
+
 def _hosts_lines(status) -> list:
     """Per-host/process table (obs/aggregate.py roll-up, when served)."""
     hosts = status.get("hosts")
@@ -320,6 +362,7 @@ def run_frame(status, ledger_path) -> str:
     lines += _throughput_lines(status)
     lines += _health_lines(status)
     lines += _sim_health_lines(status)
+    lines += _scheduler_lines(status)
     lines += _hosts_lines(status)
     lines += _campaign_lines(status, ledger_path)
     return "\n".join(lines)

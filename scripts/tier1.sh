@@ -164,18 +164,27 @@ print('span smoke ok: trace', tids.pop(), 'across', len(files),
 " || rc=1
 # Health-sentinel smoke (obs/health.py, round 17): the E2E acceptance
 # pin.  FAULT_INJECT=numerics:step=40:nan under --supervise --serve 0
-# --health must (1) end DIVERGED in /status.json (scraped live from the
-# supervisor's aggregate console), (2) make the supervisor give up
-# WITHOUT a restart loop (give_up carrying the verdict, exactly one
-# launch, no restart event), and (3) land the ledger row quarantined
-# with reason 'diverged'.  obs_top --once on the child log must exit
-# nonzero (the DIVERGED health-probe contract).
+# --health must (1) expose the sentinel's health block in /status.json
+# scraped LIVE from the supervisor's aggregate console while the run
+# is in flight, (2) rank the final verdict DIVERGED through the same
+# served-console machinery (the kill-on-fatal shuts the live console
+# down within ~100 ms of the DIVERGED event, so the terminal verdict
+# is pinned by re-serving the run's own logs — catching the transient
+# live was a race the original 64x64 leg lost on a fast machine),
+# (3) make the supervisor give up WITHOUT a restart loop (give_up
+# carrying the verdict, exactly one launch, no restart event), and
+# (4) land the ledger row quarantined with reason 'diverged'.  The
+# 4096^2 grid makes the pre-poison window (health@10 .. poison@40)
+# ~2 s — wide enough that the live scrape is deterministic, not luck.
+# obs_top --once on the child log must exit nonzero (the DIVERGED
+# health-probe contract).
 rm -rf /tmp/_t1_health
 timeout -k 10 300 env FAULT_INJECT='numerics:step=40:nan' python -c "
 import json, threading, time, urllib.request
 from cpuforce import force_cpu; force_cpu()
 from mpi_cuda_process_tpu import cli
 from mpi_cuda_process_tpu.obs import ledger
+from mpi_cuda_process_tpu.obs import serve as serve_lib
 from mpi_cuda_process_tpu.resilience import supervisor as sup
 tel = '/tmp/_t1_health/run.jsonl'
 seen = {}
@@ -193,20 +202,18 @@ def scrape():
             pass
         if url is None:
             time.sleep(0.05)
-    while time.monotonic() < deadline:
+    while time.monotonic() < deadline and 'live_health' not in seen:
         try:
             s = json.load(urllib.request.urlopen(url + '/status.json',
                                                  timeout=5))
-            seen['last'] = s
-            if s.get('verdict') == 'DIVERGED':
-                seen['diverged'] = s
-                return
+            if (s.get('health') or {}).get('verdict'):
+                seen['live_health'] = s
         except OSError:
             pass
-        time.sleep(0.1)
+        time.sleep(0.05)
 t = threading.Thread(target=scrape); t.start()
 rc = sup.run_supervised(cli.config_from_args(
-    ['--stencil', 'heat2d', '--grid', '64,64', '--iters', '100',
+    ['--stencil', 'heat2d', '--grid', '4096,4096', '--iters', '100',
      '--seed', '7', '--checkpoint-every', '10',
      '--checkpoint-dir', '/tmp/_t1_health/ck', '--telemetry', tel,
      '--health', '--supervise', '--max-restarts', '2',
@@ -214,21 +221,38 @@ rc = sup.run_supervised(cli.config_from_args(
      '--serve', '0']))
 t.join()
 assert rc == 1, f'supervisor rc={rc} (want give-up)'
-s = seen.get('diverged')
-assert s is not None, f'never saw DIVERGED in /status.json: {seen.get(\"last\", {}).get(\"verdict\")!r}'
-assert (s.get('health') or {}).get('verdict') == 'DIVERGED', s.get('health')
-evs = [json.loads(line)
-       for line in open(sup.sibling_path(tel, 'supervisor')) if line.strip()]
+s = seen.get('live_health')
+assert s is not None, 'never saw a live health block in /status.json'
+hv = s['health']['verdict']
+assert hv in ('HEALTHY', 'DIVERGED'), hv
+assert s.get('verdict') == ('DIVERGED' if hv == 'DIVERGED'
+                            else 'ALIVE'), s.get('verdict')
+suplog = sup.sibling_path(tel, 'supervisor')
+att0 = sup.sibling_path(tel, 'attempt0')
+with serve_lib.serve_aggregate([suplog, att0]) as srv:
+    s2 = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        s2 = json.load(urllib.request.urlopen(srv.url + '/status.json',
+                                              timeout=5))
+        if s2.get('verdict') == 'DIVERGED':
+            break
+        time.sleep(0.1)
+assert s2 and s2.get('verdict') == 'DIVERGED', (s2 or {}).get('verdict')
+assert (s2.get('health') or {}).get('verdict') == 'DIVERGED', \
+    s2.get('health')
+evs = [json.loads(line) for line in open(suplog) if line.strip()]
 kinds = [e.get('kind') for e in evs]
 assert 'restart' not in kinds, kinds
 assert len([e for e in evs if e.get('kind') == 'launch']) == 1, kinds
 gu = [e for e in evs if e.get('kind') == 'give_up']
 assert gu and gu[0].get('verdict') == 'DIVERGED', gu
-rows = ledger.rows_from_log(sup.sibling_path(tel, 'attempt0'))
+rows = ledger.rows_from_log(att0)
 assert rows and rows[-1]['status'] == 'quarantined' \
     and rows[-1]['quarantine'] == 'diverged', rows
-print('health smoke ok: DIVERGED in /status.json, give-up without'
-      ' restart, ledger row quarantined(diverged)')
+print('health smoke ok: live health block (%s), DIVERGED on the'
+      ' served console, give-up without restart, ledger row'
+      ' quarantined(diverged)' % hv)
 " || rc=1
 timeout -k 10 120 python scripts/obs_report.py \
   /tmp/_t1_health/run.attempt0.jsonl --check > /dev/null || rc=1
@@ -317,6 +341,70 @@ print('live-console smoke ok:', res['url'])
 " || rc=1
 timeout -k 10 120 python scripts/obs_report.py /tmp/_t1_serve.jsonl \
   --check > /dev/null || rc=1
+# Serving smoke (round 18): the continuous-batching scheduler end to
+# end — ONE resident engine, three queued jobs across TWO size
+# classes, the scheduler block scraped live from /status.json mid-run,
+# and an injected NaN (numerics fault site) that evicts ONLY the
+# poisoned member slot with round 17's DIVERGED verdict while its
+# co-tenant (same compiled step, adjacent slot) and the second class
+# finish clean — the co-tenant bit-exact against its solo replay.
+rm -rf /tmp/_t1_serving
+timeout -k 10 300 env FAULT_INJECT='numerics:step=16:nan' python -c "
+import json, os, threading, time, urllib.request
+import numpy as np
+from cpuforce import force_cpu; force_cpu(8)
+from mpi_cuda_process_tpu import cli, serving
+from mpi_cuda_process_tpu.config import RunConfig
+from mpi_cuda_process_tpu.obs.health import SimulationDiverged
+from mpi_cuda_process_tpu.resilience import faults
+eng = serving.ServingEngine(telemetry_dir='/tmp/_t1_serving',
+                            ladder=(2,), cadence=8)
+url = eng.serve(0).url
+a_cfg = dict(stencil='heat2d', grid=(32, 32), iters=4096)
+victim = eng.submit(RunConfig(seed=1, **a_cfg), tenant='alice')
+mate = eng.submit(RunConfig(seed=2, **a_cfg), tenant='bob')
+other = eng.submit(RunConfig(stencil='heat2d', grid=(32, 48), iters=8,
+                             seed=3), tenant='carol')
+seen = {}
+def scrape():
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and 'busy' not in seen:
+        try:
+            s = json.load(urllib.request.urlopen(url + '/status.json',
+                                                 timeout=5))
+            sch = s.get('scheduler')
+            if sch and sch.get('slots_busy', 0) >= 1:
+                seen['busy'] = sch
+        except OSError:
+            pass
+        time.sleep(0.05)
+t = threading.Thread(target=scrape); t.start()
+got_mate, _ = mate.result(timeout=240)
+other.result(timeout=240)
+try:
+    victim.result(timeout=240)
+    raise AssertionError('poisoned slot must raise SimulationDiverged')
+except SimulationDiverged:
+    pass
+t.join()
+assert 'busy' in seen, 'never scraped a live scheduler block'
+assert victim._phase() == 'evicted' and \
+    victim.health_verdict() == 'DIVERGED', victim._phase()
+stats = eng.close()
+assert stats['jobs_done'] == 2 and stats['jobs_evicted'] == 1, stats
+assert len(stats['class_table']) == 2, stats['class_table']
+assert stats['ttfc_p50_s'] is not None
+# the poisoned slot's co-tenant stayed bit-exact: replay it solo with
+# the (already consumed) one-shot fault disarmed
+os.environ.pop('FAULT_INJECT'); faults.reset()
+want, _ = cli.run(RunConfig(seed=2, **a_cfg))
+assert all(np.array_equal(np.asarray(a), np.asarray(b))
+           for a, b in zip(got_mate, want)), 'co-tenant not bit-exact'
+print('serving smoke ok: evicted@%d, %d done, sched busy=%s' % (
+    victim.steps_done, stats['jobs_done'], seen['busy']['slots_busy']))
+" || rc=1
+timeout -k 10 120 python scripts/obs_report.py \
+  /tmp/_t1_serving/serving-*.jsonl --check > /dev/null || rc=1
 # The committed campaign ledger must render in both one-command
 # summary surfaces: obs_report --ledger (best_known + quarantine
 # table) and the terminal monitor's ledger mode.
