@@ -343,6 +343,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "refuse with the arithmetic instead of OOMing "
                         "minutes later (utils/budget.py); warn logs the "
                         "breakdown and proceeds")
+    p.add_argument("--auto-policy", action="store_true",
+                   help="measurement-driven execution policy "
+                        "(policy/select.py): resolve every mode flag "
+                        "NOT explicitly passed (--mesh/--ensemble-mesh/"
+                        "--fuse/--fuse-kind/--overlap/--pipeline/"
+                        "--exchange) from the campaign ledger's "
+                        "best_known winner for this label x backend "
+                        "(OBS_LEDGER_PATH-aware), falling back to the "
+                        "costmodel roofline where nothing is measured.  "
+                        "Explicit flags always win and are recorded as "
+                        "overrides; the decision, its provenance "
+                        "(measured vs predicted) and the runner-up "
+                        "table land in the manifest as a 'policy' event")
+    p.add_argument("--policy-recheck", type=int, default=0, metavar="K",
+                   help="with --auto-policy: re-resolve the policy "
+                        "every K chunk boundaries and live-migrate the "
+                        "run to the new winner when its adoptable mode "
+                        "fields changed — collective redistribution "
+                        "between mesh shapes (parallel/reshard.py), "
+                        "never a host gather, bit-exact — emitting a "
+                        "'migrate' event per adoption.  0 = decide "
+                        "once at launch")
     return p
 
 
@@ -365,6 +387,7 @@ def config_from_args(argv=None) -> RunConfig:
         health=a.health, halo_audit=a.halo_audit,
         dump_every=a.dump_every, dump_dir=a.dump_dir,
         mem_check=a.mem_check,
+        auto_policy=a.auto_policy, policy_recheck=a.policy_recheck,
         supervise=a.supervise, max_restarts=a.max_restarts,
         restart_backoff=a.restart_backoff,
         supervise_stall_s=a.supervise_stall_s,
@@ -886,17 +909,37 @@ def run(cfg: RunConfig) -> Tuple:
         cfg = dataclasses.replace(cfg, telemetry=os.path.join(
             trace_lib.default_telemetry_dir(),
             f"serve-{os.getpid()}-{int(time.time())}.jsonl"))
-    fused_cfg = maybe_auto_fuse(cfg)
+    decision = None
+    if cfg.auto_policy:
+        # measurement-driven execution policy: resolve the unset mode
+        # flags from the ledger winner (costmodel fallback) BEFORE the
+        # fuse auto-upgrade — the policy's candidate space already
+        # includes the fused variants, so a resolved decision is final
+        # and maybe_auto_fuse must not silently amend it.
+        from . import policy as policy_lib
+
+        decision = policy_lib.resolve(cfg)
+        cfg = decision.config
+        log.info("policy: %s winner %s (%s)", decision.provenance,
+                 decision.label,
+                 f"{decision.value} {decision.unit}"
+                 if decision.value is not None else "no ranked candidate")
+    fused_cfg = cfg if decision is not None else maybe_auto_fuse(cfg)
     # "Did auto actually pick a Pallas path?" — not just eligibility: the
     # raw-step builder can decline (untileable shape), in which case the run
     # is pure jnp and a failure there must surface, not trigger a pointless
     # identical re-run.
     auto_pallas = fused_cfg.fuse != cfg.fuse
+    if decision is not None and cfg.fuse and \
+            "fuse" not in decision.overrides:
+        # the POLICY picked the fused path, not the user: the no-crash
+        # guarantee covers it exactly like a maybe_auto_fuse upgrade
+        auto_pallas = True
     if not auto_pallas and cfg.compute == "auto" and \
             _raw_eligible(cfg, cfg.stencil):
         auto_pallas = resolve_raw_step(cfg, _make_cfg_stencil(cfg)) is not None
     try:
-        return _run_once(fused_cfg)
+        return _run_once(fused_cfg, decision=decision)
     except Exception as e:  # noqa: BLE001 — Pallas failures surface as
         # JaxRuntimeError at execute time but as plain ValueError /
         # NotImplementedError / lowering errors at trace time; the no-crash
@@ -910,12 +953,20 @@ def run(cfg: RunConfig) -> Tuple:
             "auto-selected Pallas path failed (%s); retrying this run on "
             "the jnp path", first)
         retry_cfg = dataclasses.replace(cfg, compute="jnp")
+        if decision is not None and retry_cfg.fuse and \
+                "fuse" not in decision.overrides:
+            # a policy-chosen fused mode keeps its kernel on the jnp
+            # retry config unless cleared — strip the Pallas-only modes
+            # back to the plain path the retry is promising
+            retry_cfg = dataclasses.replace(
+                retry_cfg, fuse=0, fuse_kind="auto", pipeline=False,
+                exchange="ppermute")
         if cfg.telemetry:
             # keep the failed run's trace (it recorded the error event);
             # the retry writes its own log next to it
             retry_cfg = dataclasses.replace(
                 retry_cfg, telemetry=cfg.telemetry + ".retry.jsonl")
-        return _run_once(retry_cfg)
+        return _run_once(retry_cfg, decision=decision)
 
 
 def _looks_like_pallas_failure(e: BaseException) -> bool:
@@ -1069,13 +1120,18 @@ def _open_serve(cfg: RunConfig, session):
         return None
 
 
-def _run_once(cfg: RunConfig) -> Tuple:
+def _run_once(cfg: RunConfig, decision=None) -> Tuple:
     if not cfg.telemetry:
-        return _run_measured(cfg, None)
+        return _run_measured(cfg, None, decision=decision)
     session = _open_telemetry(cfg)
     server = _open_serve(cfg, session)
     try:
-        return _run_measured(cfg, session)
+        if decision is not None:
+            # the decision and its provenance become part of the run's
+            # manifest trail — perf_gate --policy-check replays exactly
+            # this event against the current ledger
+            session.event("policy", **decision.as_event())
+        return _run_measured(cfg, session, decision=decision)
     except cancellation.RunCancelled as e:
         # a cancel is a third terminal outcome, not an error: the log
         # records a 'cancelled' event (ledger quarantines with reason
@@ -1094,7 +1150,7 @@ def _run_once(cfg: RunConfig) -> Tuple:
             server.close()
 
 
-def _run_measured(cfg: RunConfig, session) -> Tuple:
+def _run_measured(cfg: RunConfig, session, decision=None) -> Tuple:
     if cfg.debug_checks and cfg.fuse:
         raise ValueError("--debug-checks excludes --fuse (the fused "
                          "kernel replaces the step being instrumented)")
@@ -1118,6 +1174,20 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
         raise ValueError(
             "--halo-audit runs at chunk boundaries; --tol runs inside "
             "one while_loop with no boundary to audit at")
+    if cfg.policy_recheck:
+        if not cfg.auto_policy:
+            raise ValueError("--policy-recheck re-resolves the auto "
+                             "policy; it needs --auto-policy")
+        if cfg.tol > 0:
+            raise ValueError(
+                "--policy-recheck adopts at chunk boundaries; --tol "
+                "runs inside one while_loop with no boundary to "
+                "migrate at")
+        if cfg.halo_audit:
+            raise ValueError(
+                "--policy-recheck can live-migrate the mesh out from "
+                "under the halo auditor's compiled exchange; run the "
+                "audit or the elastic policy, not both")
     _check_mem_budget(cfg)
     enable_compile_cache(cfg.compile_cache)
     mesh_lib.bootstrap_distributed()
@@ -1358,6 +1428,87 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
             observer = runtime_lib.RuntimeRecorder(step_unit=step_unit)
         observer.profiler = prof
 
+    migrator = None
+    if cfg.auto_policy and cfg.policy_recheck > 0 and interval:
+        from . import policy as policy_lib
+        from .parallel import reshard as reshard_lib
+
+        # The launch-time locked set: the decision recorded it; a
+        # direct call without one derives it from cfg (no resolution
+        # happened, so non-default mode fields ARE the explicit ones).
+        launch_locked = (frozenset(decision.overrides)
+                         if decision is not None
+                         else policy_lib.locked_fields(cfg))
+        mig_state = {"cfg": cfg, "boundaries": 0, "count": 0}
+
+        def migrator(done_calls, fs):
+            nonlocal step_fn
+            step = (start_step // step_unit + done_calls) * step_unit
+            mig_state["boundaries"] += 1
+            if mig_state["boundaries"] % cfg.policy_recheck:
+                return None
+            cur = mig_state["cfg"]
+            policy_lib.maybe_inject(step)
+            try:
+                dec = policy_lib.resolve(cur, locked=launch_locked,
+                                         adoptable=True)
+            except Exception as e:  # noqa: BLE001 — a recheck must
+                # never kill a healthy run; the current layout stands
+                log.warning("policy recheck failed at step %d: %s",
+                            step, e)
+                return None
+            new_cfg = dec.config
+            if all(getattr(new_cfg, f) == getattr(cur, f)
+                   for f in policy_lib.MODE_FIELDS):
+                return None
+            if _uses_mesh(cur) and not _uses_mesh(new_cfg):
+                # adopting an unsharded layout would be the host gather
+                # the reshard contract forbids; stay put
+                return None
+            ndim = len(cur.grid)
+            try:
+                _st2, new_step_fn, _discard, _ = build(
+                    dataclasses.replace(new_cfg, resume=False))
+                src = mesh_lib.make_mesh(
+                    cur.mesh, ensemble=cur.ensemble_mesh or 1) \
+                    if _uses_mesh(cur) else None
+                dst = mesh_lib.make_mesh(
+                    new_cfg.mesh, ensemble=new_cfg.ensemble_mesh or 1) \
+                    if _uses_mesh(new_cfg) else None
+                plan = (reshard_lib.plan_reshard(
+                    tuple(fs[0].shape), src, dst, ndim,
+                    ensemble=cur.ensemble)
+                    if src is not None and dst is not None else None)
+                new_fields = reshard_lib.reshard_fields(
+                    tuple(fs), src, dst, ndim, ensemble=cur.ensemble)
+            except Exception as e:  # noqa: BLE001
+                log.warning(
+                    "migration to %s failed at step %d: %s (run "
+                    "continues on the current layout)",
+                    dec.label, step, e)
+                return None
+            mig_state["cfg"] = new_cfg
+            mig_state["count"] += 1
+            log.info("policy: migrating to %s at step %d (%s winner, "
+                     "%d comm rounds)", dec.label, step, dec.provenance,
+                     plan.n_comm_rounds if plan is not None else 0)
+            if session is not None:
+                session.event(
+                    "migrate", step=step, n=mig_state["count"],
+                    label=dec.label, provenance=dec.provenance,
+                    value=dec.value,
+                    rounds=(plan.n_comm_rounds if plan is not None
+                            else 0),
+                    src={f: policy_lib.select._json_val(getattr(cur, f))
+                         for f in policy_lib.MODE_FIELDS},
+                    dst={f: policy_lib.select._json_val(
+                        getattr(new_cfg, f))
+                        for f in policy_lib.MODE_FIELDS})
+            # rebind the enclosing step_fn so the diagnostics path in
+            # callback() sees the program that matches the new layout
+            step_fn = new_step_fn
+            return new_step_fn, tuple(new_fields)
+
     t0 = time.perf_counter()
     try:
         with _profiled(cfg):
@@ -1366,7 +1517,7 @@ def _run_measured(cfg: RunConfig, session) -> Tuple:
                 log_every=interval, callback=callback,
                 start_step=start_step // step_unit,
                 runner_factory=runner_factory,
-                observer=observer)
+                observer=observer, migrator=migrator)
             fields = jax.block_until_ready(fields)
     finally:
         if prof is not None:

@@ -65,6 +65,20 @@ class RunConfig:
     # rings, ops/pallas/remote.py — streaming kind only, never a silent
     # fallback)
     exchange: str = "ppermute"
+    # measurement-driven execution policy (policy/select.py): resolve
+    # every mode flag NOT explicitly passed (--mesh/--ensemble-mesh/
+    # --fuse/--fuse-kind/--overlap/--pipeline/--exchange) from the
+    # campaign ledger's best_known winner for this label x backend,
+    # falling back to the costmodel roofline where nothing is measured.
+    # Explicit flags always win and are recorded as overrides in the
+    # manifest 'policy' event.
+    auto_policy: bool = False
+    # >0 with --auto-policy: re-resolve the policy every K chunk
+    # boundaries and, when the winner's ADOPTABLE mode fields changed,
+    # live-migrate the run to it (parallel/reshard.py collective
+    # redistribution — no host gather, bit-exact) and emit a 'migrate'
+    # event.  0 = decide once at launch.
+    policy_recheck: int = 0
     check_finite: int = 0  # >0: assert all fields finite every N steps
     debug_checks: bool = False  # checkify NaN/bounds checks, step-localized
     # numerics sentinel (obs/health.py): a separately-jitted sharded
@@ -160,6 +174,13 @@ LIFECYCLE_FIELDS = frozenset({
     "telemetry", "mem_check", "supervise", "max_restarts",
     "restart_backoff", "supervise_stall_s", "serve_port",
     "compile_cache", "serve_engine",
+    # policy_recheck is WHEN mid-flight adoption is reconsidered, not
+    # what is computed — migration is bit-exact by the reshard
+    # contract, so two submissions differing only here share a
+    # trajectory.  auto_policy stays a SIM field: it picks the
+    # compiled program (the serving engine resolves it away before
+    # computing a class signature).
+    "policy_recheck",
 })
 
 SIM_FIELDS = frozenset(

@@ -362,6 +362,7 @@ def run_simulation(
     start_step: int = 0,
     runner_factory=None,
     observer=None,
+    migrator=None,
 ) -> Fields:
     """Run ``n_steps``, optionally surfacing state every ``log_every`` steps.
 
@@ -387,6 +388,16 @@ def run_simulation(
     hook through which :func:`make_checked_runner` instruments debug runs —
     the absolute step makes its error messages name the true failing step
     across chunks and resumes).
+
+    ``migrator(steps_done, fields)`` is the elastic-execution adoption
+    seam (``--auto-policy --policy-recheck``): called after the
+    callback at every chunk boundary, it may return a replacement
+    ``(step_fn, fields)`` pair — typically the same state live-
+    resharded onto a different mesh (``parallel/reshard.py``) plus the
+    step program built for it.  On a swap the compiled chunk runners
+    are dropped (they close over the old step_fn) and rebuilt lazily;
+    with ``--compile-cache`` a shape the machine has seen before skips
+    the real XLA work.  ``None`` continues unchanged.
 
     ``observer`` (telemetry, ``obs/runtime.py``) receives
     ``begin_chunk()`` / ``record_chunk(steps, seconds)`` around each
@@ -415,7 +426,8 @@ def run_simulation(
         observer.record_chunk(n, time.perf_counter() - t0)
         return out
 
-    if not log_every or (callback is None and observer is None):
+    if not log_every or (callback is None and observer is None
+                         and migrator is None):
         return _run_chunk(runner_factory(step_fn, n_steps), fields,
                           n_steps, start_step)
 
@@ -433,4 +445,9 @@ def run_simulation(
             replacement = callback(done, fields)
             if replacement is not None:
                 fields = replacement
+        if migrator is not None and done < n_steps:
+            swap = migrator(done, fields)
+            if swap is not None:
+                step_fn, fields = swap
+                runners.clear()  # compiled over the old step_fn
     return fields

@@ -23,6 +23,12 @@ validator is the single definition) and the same event vocabulary:
 * ``halo_audit`` — one bit-exact ghost-slab audit pass (``health.py``
   ``--halo-audit``: received slabs vs neighbor interiors, localized
   to (field, axis, direction, ring-shard) on mismatch)
+* ``policy``     — the auto-policy decision (``policy/select.py``):
+  chosen mode fields, measured-vs-predicted provenance, explicit-flag
+  overrides, and the ranked runner-up table
+* ``migrate``    — one live mesh migration (``parallel/reshard.py``):
+  src/dst mode fields, the adopting step, and the collective round
+  count (never a host gather)
 * ``error`` / ``summary`` — how the run ended
 
 Sibling stores complete the layer: ``profile.py`` wraps a

@@ -686,12 +686,25 @@ def ingest_results(out_path: str,
 
 # ----------------------------------------------------------- baselines
 
+def _best_order(row: Dict[str, Any]) -> Tuple[Any, ...]:
+    """Total order for best_known: value, then measured_at, then the
+    full key identity and source.  The trailing components never
+    change WHICH measurement wins on merit — they only make ties
+    impossible, so the winner is a pure function of the row SET and
+    repeated policy resolution over the same ledger can never flip its
+    decision with row order (the auto-policy determinism contract)."""
+    return (row["value"], row.get("measured_at") or 0,
+            row.get("key_id") or key_id(row["key"]),
+            str(row.get("source") or ""))
+
+
 def best_known(rows: Iterable[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
     """Best ok value per (label, backend), with full row provenance.
 
     Quarantined rows are structurally excluded — the function reads
     ``status`` only, so no stale/0.0/wedged record can ever surface as
-    a baseline (the acceptance criterion).
+    a baseline (the acceptance criterion).  Ties are broken by the
+    total order of :func:`_best_order`, never by file position.
     """
     best: Dict[str, Dict[str, Any]] = {}
     for r in rows:
@@ -699,8 +712,7 @@ def best_known(rows: Iterable[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
             continue
         bk = baseline_key(r)
         cur = best.get(bk)
-        if cur is None or (r["value"], r.get("measured_at") or 0) > \
-                (cur["value"], cur.get("measured_at") or 0):
+        if cur is None or _best_order(r) > _best_order(cur):
             best[bk] = r
     return best
 

@@ -359,6 +359,11 @@ class RunMetrics:
         # queue depth, slot occupancy, per-op and per-tenant counters —
         # rendered under status()["scheduler"] and the obs_top panel
         self.scheduler: Optional[Dict[str, Any]] = None
+        # elastic-engine trail (policy/select.py + parallel/reshard.py):
+        # the active auto-policy decision and every live migration, so
+        # an operator can see what the engine decided and why
+        self.policy: Optional[Dict[str, Any]] = None
+        self.migrations: List[Dict[str, Any]] = []
         self.launches: List[Dict[str, Any]] = []
         self.restarts: List[Dict[str, Any]] = []
         self.give_up: Optional[Dict[str, Any]] = None
@@ -648,6 +653,39 @@ class RunMetrics:
             "halo-exchange transport and its honest backend tag").set(
             mode=rec.get("mode"), backend=rec.get("backend"))
 
+    def _on_policy(self, rec: Dict[str, Any]) -> None:
+        """Fold the auto-policy decision (policy/select.py): what the
+        engine chose to run and WHY — measured ledger winner or
+        costmodel prediction — plus any explicit-flag overrides."""
+        self.policy = rec
+        self.registry.counter("obs_policy_decisions_total",
+                              "auto-policy resolutions ingested").inc()
+        self.registry.info(
+            "obs_policy_decision",
+            "active execution-policy decision and its provenance").set(
+            provenance=rec.get("provenance"), label=rec.get("label"),
+            backend=rec.get("backend"),
+            overrides=",".join(sorted(rec.get("overrides") or ())) or None)
+        v = rec.get("value")
+        if isinstance(v, (int, float)):
+            self.registry.gauge(
+                "obs_policy_winner_mcells_per_s",
+                "the chosen config's ranked value (measured Mcells/s "
+                "or roofline prediction)").set(v)
+
+    def _on_migrate(self, rec: Dict[str, Any]) -> None:
+        """Fold one live mesh migration (parallel/reshard.py adoption):
+        the run re-sharded to a new winner mid-flight."""
+        self.migrations.append(rec)
+        self.registry.counter(
+            "obs_policy_migrations_total",
+            "live mesh migrations adopted mid-flight").inc()
+        step = rec.get("step")
+        if isinstance(step, (int, float)):
+            self.registry.gauge(
+                "obs_policy_last_migration_step",
+                "absolute step of the latest live migration").set(step)
+
     def _on_label(self, rec: Dict[str, Any]) -> None:
         label = rec.get("label")
         if not isinstance(label, str):
@@ -838,6 +876,16 @@ class RunMetrics:
                 out["cancelled"] = self.cancelled
             if self.scheduler is not None:
                 out["scheduler"] = self.scheduler
+            if self.policy is not None or self.migrations:
+                pol = dict(self.policy or {})
+                pol.pop("kind", None)
+                pol.pop("table", None)  # ranked table stays in the log
+                out["policy"] = {
+                    **pol,
+                    "migrations": len(self.migrations),
+                    "last_migration": (self.migrations[-1]
+                                       if self.migrations else None),
+                }
             if self.trace_id is not None:
                 out["trace_id"] = self.trace_id
             if self.time_to_first_chunk_s is not None:

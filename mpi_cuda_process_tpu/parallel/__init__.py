@@ -1,5 +1,6 @@
 from .halo import exchange_and_pad, exchange_pad_axis
 from .mesh import bootstrap_distributed, make_mesh, spatial_axis_names
+from .reshard import plan_reshard, reshard_fields
 from .stepper import grid_partition_spec, make_sharded_step, shard_fields
 
 __all__ = [
@@ -9,6 +10,8 @@ __all__ = [
     "grid_partition_spec",
     "make_mesh",
     "make_sharded_step",
+    "plan_reshard",
+    "reshard_fields",
     "shard_fields",
     "spatial_axis_names",
 ]

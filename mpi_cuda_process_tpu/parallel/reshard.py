@@ -1,0 +1,375 @@
+"""Live state migration between mesh shapes — no host gather, ever.
+
+The elastic-engine piece of the auto-policy loop (ROADMAP item 3): when
+the campaign ledger says a different decomposition is faster, a running
+simulation adopts it MID-FLIGHT, at a chunk boundary, bit-exactly.  The
+reference cannot even express this (its decomposition is compiled into
+per-rank code); the classical MPI answer is portable collective
+redistribution (arXiv:2112.01075) — and that is exactly what this module
+builds, the JAX way: the whole relayout is a fixed sequence of
+``lax.ppermute`` rounds inside ``shard_map``, planned on the host,
+executed device-to-device.  No process ever materializes the full grid
+(the same discipline the per-shard Orbax restore path already proves,
+pinned here by ``utils/jaxprcheck.assert_reshard_structure``).
+
+How the plan works (host side, pure numpy/python):
+
+* Per array axis ``a`` the two layouts slice the global extent into
+  ``s_a`` (source) and ``t_a`` (target) equal blocks.  The common
+  refinement is ``A_a = lcm(s_a, t_a)`` **atoms** per axis — every
+  source block and every target block is a whole number of atoms, so an
+  atom is the largest unit that never needs splitting.
+* With equal device counts ``D`` on both meshes, every device holds
+  exactly ``K = prod(A_a) / D`` atoms in EITHER layout.  The atom
+  transfer graph (source device -> target device, one edge per atom) is
+  therefore a K-regular bipartite multigraph, which decomposes into K
+  perfect matchings (Hall's theorem; found by augmenting paths).  Each
+  matching is one ``ppermute`` round: every device sends exactly one
+  atom and receives exactly one — no fan-in, no serialization, and a
+  round whose matching is the identity moves data between local slots
+  only (no collective at all).
+* Executed as two ``shard_map`` stages: stage 1 (over the SOURCE mesh)
+  restacks the local block into its atoms and runs the K rounds,
+  emitting each device's received pile as one block of a global
+  ``(D, K, *atom)`` array sharded jointly over all source axes; stage 2
+  (over the TARGET mesh) reads the same array — physically the identical
+  per-device layout, both meshes enumerate ``jax.devices()`` in flat
+  row-major order — and restacks the pile into the target block.  The
+  flat device ids used by the plan follow the same row-major
+  linearization as ``halo.neighbor_logical_ids`` and multi-axis
+  ``ppermute``.
+
+Supported relayouts: anything between two meshes over the SAME devices
+in the same order — z-only <-> y-only <-> 2-axis <-> 3-axis, and
+ensemble-axis repacking (the member axis is just one more array axis to
+the planner).  Unsharded -> sharded is a plain scatter
+(``shard_fields``); sharded -> unsharded would BE a host gather and is
+refused.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import ENSEMBLE_AXIS
+from .stepper import (_resolve_mesh_axes, ensemble_partition_spec,
+                      grid_partition_spec, shard_fields, shard_map)
+
+
+def _axis_counts(mesh: Mesh, grid_ndim: int, ensemble: int) -> Tuple[int, ...]:
+    """Per-ARRAY-axis shard counts (member axis first when batched)."""
+    _, counts = _resolve_mesh_axes(grid_ndim, mesh)
+    if ensemble:
+        return (int(mesh.shape.get(ENSEMBLE_AXIS, 1)),) + counts
+    return counts
+
+
+def _mesh_axis_to_array_axis(mesh: Mesh, grid_ndim: int,
+                             ensemble: int) -> Dict[str, int]:
+    """Which array axis each mesh axis decomposes."""
+    from .mesh import spatial_axis_names
+
+    off = 1 if ensemble else 0
+    out: Dict[str, int] = {}
+    for name in mesh.axis_names:
+        if name == ENSEMBLE_AXIS:
+            if not ensemble:
+                raise ValueError(
+                    "mesh carries an ensemble axis but the migration is "
+                    "unbatched (ensemble=0)")
+            out[name] = 0
+        else:
+            out[name] = spatial_axis_names(grid_ndim).index(name) + off
+    return out
+
+
+class _Round:
+    """One matching: the ppermute pairs + per-device slot tables."""
+
+    __slots__ = ("perm", "send", "recv", "identity")
+
+    def __init__(self, perm, send, recv):
+        self.perm = tuple(perm)
+        self.send = np.asarray(send, np.int32)
+        self.recv = np.asarray(recv, np.int32)
+        self.identity = all(i == j for i, j in self.perm)
+
+
+class ReshardPlan:
+    """Host-side relayout plan between two mesh shapes (see module doc).
+
+    Attributes the executor and the jaxpr gate read:
+
+    * ``rounds`` — the K matchings; ``n_comm_rounds`` counts the
+      non-identity ones (== expected ppermutes per field).
+    * ``atom_shape`` / ``k`` — per-device pile geometry.
+    """
+
+    def __init__(self, array_shape: Tuple[int, ...], src_mesh: Mesh,
+                 dst_mesh: Mesh, grid_ndim: int, ensemble: int):
+        self.src_mesh, self.dst_mesh = src_mesh, dst_mesh
+        self.grid_ndim, self.ensemble = grid_ndim, int(ensemble)
+        self.array_shape = tuple(int(s) for s in array_shape)
+
+        src_flat = list(src_mesh.devices.flat)
+        dst_flat = list(dst_mesh.devices.flat)
+        if len(src_flat) != len(dst_flat):
+            raise ValueError(
+                f"reshard needs equal device counts: source mesh uses "
+                f"{len(src_flat)}, target {len(dst_flat)}")
+        if any(a != b for a, b in zip(src_flat, dst_flat)):
+            raise ValueError(
+                "reshard needs both meshes over the same devices in the "
+                "same flat order (make_mesh guarantees this)")
+        self.n_devices = len(src_flat)
+
+        s_counts = _axis_counts(src_mesh, grid_ndim, ensemble)
+        t_counts = _axis_counts(dst_mesh, grid_ndim, ensemble)
+        self.src_counts, self.dst_counts = s_counts, t_counts
+        atoms_per_axis = tuple(math.lcm(s, t)
+                               for s, t in zip(s_counts, t_counts))
+        for g, a in zip(self.array_shape, atoms_per_axis):
+            if g % a:
+                raise ValueError(
+                    f"global extent {g} not divisible by the atom count "
+                    f"{a} (= lcm of the two per-axis shard counts) — "
+                    "the relayout cannot tile this pair of meshes")
+        self.atoms_per_axis = atoms_per_axis
+        self.atom_shape = tuple(g // a for g, a in
+                                zip(self.array_shape, atoms_per_axis))
+        self.src_local = tuple(a // s for a, s in
+                               zip(atoms_per_axis, s_counts))
+        self.dst_local = tuple(a // t for a, t in
+                               zip(atoms_per_axis, t_counts))
+        self.k = int(np.prod(self.src_local))
+        assert self.k == int(np.prod(self.dst_local))
+
+        self.rounds = self._decompose()
+        self.n_comm_rounds = sum(1 for r in self.rounds if not r.identity)
+
+    # ---------------------------------------------------- plan building
+
+    def _device_of(self, mesh: Mesh, atom: Tuple[int, ...],
+                   ax_of: Dict[str, int]) -> int:
+        """Flat (row-major over ``mesh.axis_names``) id owning ``atom``."""
+        fid = 0
+        for name in mesh.axis_names:
+            size = int(mesh.shape[name])
+            a = ax_of[name]
+            fid = fid * size + atom[a] // (self.atoms_per_axis[a] // size)
+        return fid
+
+    @staticmethod
+    def _local_index(atom: Tuple[int, ...],
+                     local: Tuple[int, ...]) -> int:
+        """Row-major slot of ``atom`` in its owner's local atom grid."""
+        idx = 0
+        for a, l in zip(atom, local):
+            idx = idx * l + a % l
+        return idx
+
+    def _decompose(self) -> List[_Round]:
+        D = self.n_devices
+        src_ax = _mesh_axis_to_array_axis(self.src_mesh, self.grid_ndim,
+                                          self.ensemble)
+        dst_ax = _mesh_axis_to_array_axis(self.dst_mesh, self.grid_ndim,
+                                          self.ensemble)
+        piles: Dict[Tuple[int, int], List[Tuple[int, int]]] = {}
+        count = np.zeros((D, D), np.int64)
+        for atom in np.ndindex(*self.atoms_per_axis):
+            i = self._device_of(self.src_mesh, atom, src_ax)
+            j = self._device_of(self.dst_mesh, atom, dst_ax)
+            piles.setdefault((i, j), []).append(
+                (self._local_index(atom, self.src_local),
+                 self._local_index(atom, self.dst_local)))
+            count[i, j] += 1
+
+        rounds: List[_Round] = []
+        for _ in range(self.k):
+            match = _perfect_matching(count)
+            perm, send, recv = [], [0] * D, [0] * D
+            for i in range(D):
+                j = match[i]
+                sl, rl = piles[(i, j)].pop()
+                count[i, j] -= 1
+                send[i], recv[j] = sl, rl
+                perm.append((i, j))
+            rounds.append(_Round(perm, send, recv))
+        assert not count.any()
+        return rounds
+
+
+def _perfect_matching(count: np.ndarray) -> List[int]:
+    """One perfect matching of the remaining atom multigraph
+    (Kuhn's augmenting paths; regularity guarantees existence).
+    ``count[i, j]`` = atoms still to move from source device i to target
+    device j.  Prefers the diagonal so stay-local atoms batch into
+    identity (collective-free) rounds.  Returns ``match[i] = j``.
+    """
+    D = count.shape[0]
+    owner = [-1] * D  # target j -> source i
+
+    def order(i):
+        return [i] + [j for j in range(D) if j != i]
+
+    def augment(i, seen):
+        for j in order(i):
+            if count[i, j] > 0 and j not in seen:
+                seen.add(j)
+                if owner[j] < 0 or augment(owner[j], seen):
+                    owner[j] = i
+                    return True
+        return False
+
+    for i in range(D):
+        if not augment(i, set()):
+            raise RuntimeError(
+                "no perfect matching — the atom graph lost regularity "
+                "(planner invariant violated)")
+    match = [-1] * D
+    for j, i in enumerate(owner):
+        match[i] = j
+    return match
+
+
+def plan_reshard(array_shape: Sequence[int], src_mesh: Mesh,
+                 dst_mesh: Mesh, grid_ndim: int,
+                 ensemble: int = 0) -> Optional[ReshardPlan]:
+    """Build the relayout plan, or ``None`` when the two meshes already
+    induce the identical per-device layout (nothing to move)."""
+    s = _axis_counts(src_mesh, grid_ndim, ensemble)
+    t = _axis_counts(dst_mesh, grid_ndim, ensemble)
+    if s == t:
+        return None
+    return ReshardPlan(tuple(array_shape), src_mesh, dst_mesh,
+                       grid_ndim, ensemble)
+
+
+# ------------------------------------------------------------ executor
+
+def _flat_device_id(mesh: Mesh):
+    """Traced row-major flat id of the executing device — the
+    ``halo.neighbor_logical_ids`` linearization, matching both the
+    multi-axis ``ppermute`` index convention and ``mesh.devices.flat``.
+    """
+    lid = jnp.int32(0)
+    for name in mesh.axis_names:
+        lid = lid * int(mesh.shape[name]) + lax.axis_index(name)
+    return lid
+
+
+def _atomize(x, local: Tuple[int, ...], atom: Tuple[int, ...], k: int):
+    """Local block -> ``(k, *atom)`` pile, row-major slot order."""
+    m = len(atom)
+    inter = x.reshape(tuple(v for pair in zip(local, atom) for v in pair))
+    stacked = inter.transpose(tuple(range(0, 2 * m, 2))
+                              + tuple(range(1, 2 * m, 2)))
+    return stacked.reshape((k,) + atom)
+
+
+def _deatomize(pile, local: Tuple[int, ...], atom: Tuple[int, ...]):
+    """``(k, *atom)`` pile -> local block (inverse of :func:`_atomize`)."""
+    m = len(atom)
+    grid = pile.reshape(local + atom)
+    inter = grid.transpose(tuple(v for a in range(m)
+                                 for v in (a, m + a)))
+    return inter.reshape(tuple(l * e for l, e in zip(local, atom)))
+
+
+def _field_spec(mesh: Mesh, grid_ndim: int, ensemble: int) -> P:
+    return ensemble_partition_spec(grid_ndim, mesh) if ensemble \
+        else grid_partition_spec(grid_ndim, mesh)
+
+
+def make_reshard(plan: ReshardPlan, n_fields: int):
+    """The relayout executor: ``fn(fields) -> fields`` on the target
+    layout.  Pure data movement — every dtype round-trips bit-exactly.
+    Trace it (``jax.make_jaxpr``) for the structural gate; jit it (with
+    donation) to run.
+    """
+    src_axes = tuple(plan.src_mesh.axis_names)
+    dst_axes = tuple(plan.dst_mesh.axis_names)
+    k, atom = plan.k, plan.atom_shape
+    pile_rank = 1 + len(atom)
+
+    def _exchange_local(x):
+        lid = _flat_device_id(plan.src_mesh)
+        atoms = _atomize(x, plan.src_local, atom, k)
+        buf = jnp.zeros((k,) + atom, x.dtype)
+        for rnd in plan.rounds:
+            idx = jnp.asarray(rnd.send)[lid]
+            out = lax.dynamic_index_in_dim(atoms, idx, 0, keepdims=False)
+            if not rnd.identity:
+                out = lax.ppermute(out, src_axes, rnd.perm)
+            slot = jnp.asarray(rnd.recv)[lid]
+            buf = lax.dynamic_update_index_in_dim(buf, out, slot, 0)
+        return buf[None]
+
+    def _assemble_local(x):
+        return _deatomize(x[0], plan.dst_local, atom)
+
+    f_spec_src = _field_spec(plan.src_mesh, plan.grid_ndim, plan.ensemble)
+    f_spec_dst = _field_spec(plan.dst_mesh, plan.grid_ndim, plan.ensemble)
+    pile_spec_src = P(src_axes, *([None] * pile_rank))
+    pile_spec_dst = P(dst_axes, *([None] * pile_rank))
+
+    exchange = shard_map(
+        lambda *fs: tuple(_exchange_local(f) for f in fs),
+        plan.src_mesh, in_specs=(f_spec_src,) * n_fields,
+        out_specs=(pile_spec_src,) * n_fields, check_vma=False)
+    assemble = shard_map(
+        lambda *fs: tuple(_assemble_local(f) for f in fs),
+        plan.dst_mesh, in_specs=(pile_spec_dst,) * n_fields,
+        out_specs=(f_spec_dst,) * n_fields, check_vma=False)
+
+    def fn(fields):
+        # The intermediate (D, k, *atom) global array is sharded one
+        # block per device under BOTH specs — identical physical layout,
+        # so the stage handoff moves nothing.
+        return assemble(*exchange(*fields))
+
+    return fn
+
+
+def reshard_fields(fields, src_mesh: Optional[Mesh],
+                   dst_mesh: Optional[Mesh], grid_ndim: int,
+                   ensemble: int = 0):
+    """Migrate ``fields`` from ``src_mesh``'s layout to ``dst_mesh``'s.
+
+    ``None`` stands for the unsharded single-device layout: both-None is
+    the identity, unsharded -> mesh is a plain scatter
+    (:func:`shard_fields`), and mesh -> unsharded is refused (that would
+    BE the host gather this module exists to never do).  ``ensemble`` is
+    the member count of a batched run (fields carry a leading member
+    axis); 0 = unbatched.
+    """
+    fields = tuple(fields)
+    if src_mesh is None and dst_mesh is None:
+        return fields
+    if src_mesh is None:
+        return shard_fields(fields, dst_mesh, grid_ndim,
+                            ensemble=bool(ensemble))
+    if dst_mesh is None:
+        raise ValueError(
+            "reshard to the unsharded layout would materialize the full "
+            "grid on one device (a host gather) — refused; keep a mesh "
+            "or go through a per-shard checkpoint")
+    if ensemble and fields[0].shape[0] != ensemble:
+        raise ValueError(
+            f"ensemble={ensemble} but fields carry a leading axis of "
+            f"{fields[0].shape[0]}")
+    plan = plan_reshard(fields[0].shape, src_mesh, dst_mesh, grid_ndim,
+                        ensemble)
+    if plan is None:
+        # identical layout — re-tag onto the target mesh, no movement
+        return shard_fields(fields, dst_mesh, grid_ndim,
+                            ensemble=bool(ensemble))
+    fn = jax.jit(make_reshard(plan, len(fields)), donate_argnums=0)
+    return tuple(fn(fields))

@@ -1,0 +1,21 @@
+"""Measurement-driven execution policy (ROADMAP item 3).
+
+``policy.select`` promotes ``benchmarks/policy_advice.py`` from an
+offline report to the runtime policy source: given a :class:`RunConfig`
+and a backend it ranks candidate execution configs from the campaign
+ledger's ``best_known`` table (quarantined rows structurally excluded,
+exchange/ensemble keying respected) and falls back to the
+``obs/costmodel`` roofline where no measured row exists.  The CLI's
+``--auto-policy`` flag and the serving engine's submit path both resolve
+through :func:`policy.select.resolve`; explicit mode flags always win
+and are recorded as overrides in the manifest ``policy`` event.
+"""
+
+from .select import (  # noqa: F401
+    ADOPTABLE_FIELDS,
+    Decision,
+    MODE_FIELDS,
+    locked_fields,
+    maybe_inject,
+    resolve,
+)

@@ -772,6 +772,21 @@ class ServingEngine:
         from .. import obs
         from ..obs import spans as spans_lib
 
+        decision = None
+        if cfg.auto_policy:
+            # measurement-driven policy at admission time: resolve the
+            # unset mode flags against the ledger BEFORE the class
+            # signature is computed, then clear the flag — the resolved
+            # config IS the job, so its size class (and compile-cache
+            # identity) equals an identical explicit submission, and a
+            # scheduler-launched child never re-resolves.  Outside the
+            # lock: resolution reads the ledger and runs the costmodel.
+            from .. import policy as policy_lib
+
+            decision = policy_lib.resolve(cfg)
+            cfg = _dc.replace(decision.config, auto_policy=False,
+                              policy_recheck=0)
+
         with self._cv:
             if self._closing:
                 raise RuntimeError("ServingEngine is closed")
@@ -813,6 +828,11 @@ class ServingEngine:
                          "size_class": j.class_label,
                          "priced_bytes": est["total_bytes"],
                          "hbm_bytes": est["hbm_bytes"]})
+            if decision is not None:
+                # the decision trail rides the job's own manifest log,
+                # exactly like the CLI path (perf_gate --policy-check
+                # replays it against the current ledger)
+                j.session.event("policy", **decision.as_event())
             self._handles.append(j)
             self._waiting.append(j)
             if rc is None:

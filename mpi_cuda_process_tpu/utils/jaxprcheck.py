@@ -393,6 +393,76 @@ def check_ensemble_structure(
     return report
 
 
+def _shard_map_body_jaxprs(closed):
+    """The per-device body jaxpr of every ``shard_map`` eqn (any depth).
+
+    Avals inside these ARE local (per-shard) shapes — the place a
+    full-grid materialization would show up as an oversized aval.
+    """
+    for jx in iter_jaxprs(closed.jaxpr):
+        for eqn in jx.eqns:
+            if eqn.primitive.name != "shard_map":
+                continue
+            body = eqn.params.get("jaxpr")
+            if isinstance(body, jax.core.ClosedJaxpr):
+                yield body.jaxpr
+            elif isinstance(body, jax.core.Jaxpr):
+                yield body
+
+
+def assert_reshard_structure(closed, plan, n_fields: int):
+    """The live-migration headline gate (``parallel/reshard.py``): the
+    traced relayout moves state device-to-device ONLY.
+
+    Pins three promises:
+
+    1. **Zero ``all_gather``** anywhere — no collective replicates the
+       grid.
+    2. **Exact ppermute count**: ``plan.n_comm_rounds`` collective
+       rounds per field, no more (a round per matching) and no fewer (no
+       silent fallback through an XLA resharding).
+    3. **No full-grid local intermediate**: inside every ``shard_map``
+       body (where avals are per-device shapes), every aval is strictly
+       smaller than the global array — no device ever holds the whole
+       state.
+
+    Returns the counts for the caller's report.
+    """
+    n_ag = count_primitive(closed, "all_gather")
+    assert n_ag == 0, (
+        f"reshard jaxpr contains {n_ag} all_gather eqn(s) — the "
+        "relayout must never replicate the grid")
+    n_pp = count_primitive(closed, "ppermute")
+    expected = plan.n_comm_rounds * n_fields
+    assert n_pp == expected, (
+        f"reshard jaxpr contains {n_pp} ppermute eqn(s), the plan "
+        f"schedules {expected} ({plan.n_comm_rounds} non-identity "
+        f"round(s) x {n_fields} field(s))")
+    global_size = 1
+    for s in plan.array_shape:
+        global_size *= int(s)
+    max_local = 0
+    for body in _shard_map_body_jaxprs(closed):
+        for jx in iter_jaxprs(body):
+            for eqn in jx.eqns:
+                for v in list(eqn.invars) + list(eqn.outvars):
+                    aval = getattr(v, "aval", None)
+                    if aval is None or not hasattr(aval, "shape"):
+                        continue
+                    sz = 1
+                    for d in aval.shape:
+                        sz *= int(d)
+                    max_local = max(max_local, sz)
+                    assert sz < global_size, (
+                        f"reshard shard_map body holds an aval of "
+                        f"{tuple(aval.shape)} ({sz} elems) >= the global "
+                        f"array ({global_size} elems) — a device "
+                        "materialized the full grid")
+    assert max_local > 0, "reshard jaxpr has no shard_map body at all"
+    return {"n_ppermute": n_pp, "n_all_gather": n_ag,
+            "max_local_aval": max_local, "global_size": global_size}
+
+
 def check_pipeline_structure(
     stencil_name: str = "heat3d",
     grid: Tuple[int, int, int] = (32, 16, 128),

@@ -331,6 +331,45 @@ def _scheduler_lines(status) -> list:
     return lines
 
 
+def _policy_lines(status) -> list:
+    """Elastic-engine panel (policy/select.py + reshard adoption): the
+    active decision, its provenance, any overrides, migration count."""
+    pol = status.get("policy")
+    if not pol:
+        return []
+    decision = pol.get("decision") or {}
+    mode_bits = []
+    for k in ("mesh", "ensemble_mesh", "fuse", "fuse_kind", "overlap",
+              "pipeline", "exchange"):
+        v = decision.get(k)
+        if v in (None, 0, False, [], "auto", "ppermute"):
+            continue
+        mode_bits.append(f"{k}={'x'.join(map(str, v)) if isinstance(v, list) else v}")
+    val = pol.get("value")
+    bits = [pol.get("provenance") or "?",
+            pol.get("label") or "?",
+            " ".join(mode_bits) if mode_bits else "(plain)"]
+    if val is not None:
+        bits.append(f"{val} {pol.get('unit') or 'Mcells/s'}")
+    lines = ["policy  " + "  ".join(bits)]
+    overrides = pol.get("overrides") or {}
+    if overrides:
+        lines.append("        overrides: "
+                     + " ".join(f"{k}={v}" for k, v in
+                                sorted(overrides.items())))
+    n_mig = pol.get("migrations") or 0
+    last = pol.get("last_migration")
+    if n_mig and last:
+        dst = last.get("dst") or {}
+        mesh = dst.get("mesh")
+        lines.append(f"        migrations: {n_mig}  last: step "
+                     f"{last.get('step', '?')} -> "
+                     f"{last.get('label') or '?'} "
+                     f"mesh={'x'.join(map(str, mesh)) if mesh else '-'} "
+                     f"({last.get('rounds', '?')} comm rounds)")
+    return lines
+
+
 def _hosts_lines(status) -> list:
     """Per-host/process table (obs/aggregate.py roll-up, when served)."""
     hosts = status.get("hosts")
@@ -363,6 +402,7 @@ def run_frame(status, ledger_path) -> str:
     lines += _health_lines(status)
     lines += _sim_health_lines(status)
     lines += _scheduler_lines(status)
+    lines += _policy_lines(status)
     lines += _hosts_lines(status)
     lines += _campaign_lines(status, ledger_path)
     return "\n".join(lines)

@@ -32,6 +32,18 @@ one invocation both gates a round and makes it the next round's
 baseline; ``--backfill`` runs the one-shot historical ingest
 (BENCH_r0*.json + benchmarks/results_r0*.json) instead of gating.
 
+``--policy-check`` is the stale-policy detector: instead of gating
+values, it replays the manifest's recorded ``policy`` event (an
+``--auto-policy`` run records the chosen config, its provenance, the
+locked overrides, and the device count) against the CURRENT ledger —
+same requested config, same locked set, same backend and device
+budget — and exits 1 when today's winner differs from the recorded
+decision.  A clean exit means the decision that run shipped with is
+still what ``--auto-policy`` would pick; a mismatch means the ledger
+has learned something since (re-run, or expect a mid-flight migration
+under ``--policy-recheck``).  A manifest with no ``policy`` event
+passes vacuously (noted in the output).
+
 Safe on a wedged box: the CPU backend is forced before the package
 (and hence any jax backend) loads; the ledger itself never touches a
 device.
@@ -39,6 +51,7 @@ device.
 Usage:
     python scripts/perf_gate.py RUN.jsonl [--ledger PATH] [--noise F]
                                 [--dry] [--update-ledger]
+    python scripts/perf_gate.py RUN.jsonl --policy-check [--ledger PATH]
     python scripts/perf_gate.py --backfill [--ledger PATH]
 """
 
@@ -114,6 +127,76 @@ def gate(manifest_path: str, ledger_path: str, noise: float):
     return out, fresh
 
 
+def policy_check(manifest_path: str, ledger_path: str) -> int:
+    """Replay a manifest's recorded policy decision against the
+    current ledger.  Returns the exit code (0 current, 1 stale).
+
+    The manifest's ``run`` dict is the RESOLVED config (the decision
+    already applied), so the launch-time question is reconstructed
+    from the policy event itself: ``requested`` mode fields overlaid
+    on the run dict, re-resolved with the recorded locked set, backend
+    and device budget.  Everything that matters is replayed from the
+    record — the check is deterministic on any box, including one with
+    a different device count than the run had.
+    """
+    import json
+
+    manifest = None
+    event = None
+    with open(manifest_path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if manifest is None and rec.get("kind") == "manifest":
+                manifest = rec
+            if rec.get("kind") == "policy":
+                event = rec  # last wins: a retried run re-records
+    if manifest is None:
+        raise ValueError("no manifest record in the log")
+    if event is None:
+        print(f"perf_gate --policy-check: {manifest_path} has no "
+              "policy event (not an --auto-policy run) — nothing to "
+              "check")
+        return 0
+
+    from mpi_cuda_process_tpu.config import RunConfig  # noqa: E402
+    from mpi_cuda_process_tpu.policy import select as policy_select  # noqa: E402
+
+    requested = {
+        k: tuple(v) if isinstance(v, list) else v
+        for k, v in (event.get("requested") or {}).items()
+        if k in policy_select.MODE_FIELDS}
+    cfg = RunConfig.from_dict({**(manifest.get("run") or {}), **requested})
+    fresh = policy_select.resolve(
+        cfg,
+        backend=event.get("backend"),
+        ledger_path=ledger_path,
+        locked=frozenset(event.get("overrides") or {}),
+        n_devices=event.get("n_devices"))
+
+    recorded_label = event.get("label")
+    print(f"perf_gate --policy-check: {manifest_path} vs {ledger_path}")
+    print(f"  recorded: {recorded_label}  "
+          f"[{event.get('provenance')}"
+          + (f", {event['value']:g} {event.get('unit', '')}".rstrip()
+             if event.get("value") is not None else "") + "]")
+    print(f"  current:  {fresh.label}  [{fresh.provenance}"
+          + (f", {fresh.value:g} {fresh.unit}"
+             if fresh.value is not None else "") + "]")
+    if fresh.label == recorded_label:
+        print("policy-check: OK — the recorded decision is still the "
+              "ledger winner")
+        return 0
+    print("policy-check: STALE — the ledger has moved since this run's "
+          "decision was made", file=sys.stderr)
+    for row in fresh.table[:4]:
+        print(f"    {row['provenance']:<9} {row['value']:>10g}  "
+              f"{row['label']}")
+    return 1
+
+
 def _table(rows):
     header = ["label", "verdict", "fresh", "baseline", "ratio", "why/src"]
     body = []
@@ -154,8 +237,28 @@ def main(argv=None) -> int:
                          "gating (idempotent)")
     ap.add_argument("--backfill", action="store_true",
                     help="one-shot historical ingest instead of gating")
+    ap.add_argument("--policy-check", action="store_true",
+                    help="replay the manifest's recorded policy "
+                         "decision against the current ledger instead "
+                         "of gating values; exit 1 when the winner "
+                         "has moved")
     a = ap.parse_args(argv)
     ledger_path = a.ledger or ledger_lib.default_ledger_path()
+
+    if a.policy_check:
+        if not a.manifest:
+            ap.error("--policy-check needs a telemetry manifest")
+        try:
+            rc = policy_check(a.manifest, ledger_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"perf_gate: cannot policy-check {a.manifest}: {e}",
+                  file=sys.stderr)
+            return 2
+        if rc and a.dry:
+            print("perf_gate: --dry — stale policy reported, exit "
+                  "forced 0")
+            return 0
+        return rc
 
     if a.backfill:
         out = ledger_lib.backfill(ledger_path=ledger_path)

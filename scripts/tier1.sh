@@ -405,6 +405,66 @@ print('serving smoke ok: evicted@%d, %d done, sched busy=%s' % (
 " || rc=1
 timeout -k 10 120 python scripts/obs_report.py \
   /tmp/_t1_serving/serving-*.jsonl --check > /dev/null || rc=1
+# Elastic-policy smoke (round 19): measurement-driven --auto-policy +
+# live no-gather mesh migration end to end.  The run launches on the
+# ledger's measured winner (8,1,1); POLICY_INJECT flips the measured
+# winner to (1,1,8) at step 20; the recheck must adopt it at that
+# chunk boundary (a 'migrate' event with a nonzero collective round
+# count — reshard.py, never a host gather) and the final fields must
+# bit-match an UNINTERRUPTED run under the target mesh.
+rm -rf /tmp/_t1_policy
+mkdir -p /tmp/_t1_policy
+timeout -k 10 300 python -c "
+import dataclasses, json, os, time
+import numpy as np
+from cpuforce import force_cpu; force_cpu(8)
+os.environ['OBS_LEDGER_PATH'] = '/tmp/_t1_policy/ledger.jsonl'
+from mpi_cuda_process_tpu import cli
+from mpi_cuda_process_tpu.config import RunConfig
+from mpi_cuda_process_tpu.obs import ledger
+from mpi_cuda_process_tpu.policy import select as ps
+base = RunConfig(stencil='heat3d', grid=(16, 16, 16), iters=40,
+                 log_every=10)
+def row(mesh, value, path, source):
+    c = dataclasses.replace(base, mesh=mesh)
+    label, _ = ps._ledger_identity(c, 'cpu')
+    ledger.append_rows([ledger.make_row(
+        label, value, source=source, measured_at=time.time(),
+        backend='cpu', flags=ledger._flags(dataclasses.asdict(c)))], path)
+row((8, 1, 1), 500.0, '/tmp/_t1_policy/ledger.jsonl', 'seed')
+row((1, 1, 8), 900.0, '/tmp/_t1_policy/inject.jsonl', 'inject')
+os.environ['POLICY_INJECT'] = 'step=20:/tmp/_t1_policy/inject.jsonl'
+tel = '/tmp/_t1_policy/run.jsonl'
+fields, _ = cli.run(dataclasses.replace(base, auto_policy=True,
+                                        policy_recheck=1, telemetry=tel))
+evs = [json.loads(l) for l in open(tel) if l.strip()]
+pol = [e for e in evs if e['kind'] == 'policy']
+assert pol and pol[0]['provenance'] == 'measured' \
+    and pol[0]['decision']['mesh'] == [8, 1, 1], pol
+mig = [e for e in evs if e['kind'] == 'migrate']
+assert len(mig) == 1 and mig[0]['step'] == 20 \
+    and mig[0]['dst']['mesh'] == [1, 1, 8] and mig[0]['rounds'] > 0, mig
+os.environ.pop('POLICY_INJECT')
+want, _ = cli.run(dataclasses.replace(base, mesh=(1, 1, 8)))
+assert all(np.array_equal(np.asarray(a), np.asarray(b))
+           for a, b in zip(fields, want)), 'migrated run not bit-exact'
+print('policy smoke ok: launched (8,1,1) [measured], migrated @%d to'
+      ' (1,1,8) in %d rounds, bit-exact' % (mig[0]['step'],
+                                            mig[0]['rounds']))
+" || rc=1
+timeout -k 10 120 python scripts/obs_report.py /tmp/_t1_policy/run.jsonl \
+  --check > /dev/null || rc=1
+# The stale-policy detector (perf_gate --policy-check): the injected
+# row moved the ledger AFTER the recorded decision, so the replay must
+# exit nonzero; --dry reports the same mismatch but forces 0.
+if timeout -k 10 120 python scripts/perf_gate.py /tmp/_t1_policy/run.jsonl \
+     --policy-check --ledger /tmp/_t1_policy/ledger.jsonl > /dev/null; then
+  echo 'perf_gate --policy-check must exit nonzero on a moved ledger' >&2
+  rc=1
+fi
+timeout -k 10 120 python scripts/perf_gate.py /tmp/_t1_policy/run.jsonl \
+  --policy-check --dry --ledger /tmp/_t1_policy/ledger.jsonl \
+  > /dev/null || rc=1
 # The committed campaign ledger must render in both one-command
 # summary surfaces: obs_report --ledger (best_known + quarantine
 # table) and the terminal monitor's ledger mode.
