@@ -58,6 +58,25 @@ def _parse_kspec(spec):
     return int(spec), None
 
 
+def _parse_tune(spec):
+    """Strip a trailing ``_tuneN`` token: ``"4_shard_tune2"`` ->
+    ("4_shard", 2); absent -> 0.
+
+    The kernel-variant sweep label family (Tier-D13, ISSUE 16): N is a
+    1-BASED index into the autotuner's per-family campaign order
+    (``policy.autotune.STREAM_SWEEP`` / ``RDMA_SWEEP``, append-only),
+    resolved through :func:`policy.autotune.tune_variant` — so the
+    labels stay stable while the registry grows, and the A/B against
+    the same-shape default-constant row prices exactly one swept
+    constant set."""
+    if "_tune" not in spec:
+        return spec, 0
+    head, _, num = spec.rpartition("_tune")
+    if not num.isdigit():
+        raise ValueError(f"malformed _tune token in spec {spec!r}")
+    return head, int(num)
+
+
 def _parse_ens(spec):
     """Strip an ``_ensN`` token: ``"4_ens8"`` -> ("4", 8); absent -> 0.
 
@@ -105,8 +124,12 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
     rings into the neighbor via make_async_remote_copy, zero XLA
     ppermute in the step — the A/B against streamK_shard /
     streamK_meshZxY prices the exchange transport, same kernel class
-    on both rows) | copy (harness-calibration
-    1R+1W elementwise scan).
+    on both rows; a trailing ``_tuneN`` token on sharded stream and
+    rdma specs — ``streamK_shard_tuneN``, ``rdmaK_tuneN`` — runs the
+    same step under the autotuner registry's Nth campaign variant for
+    the family (policy/autotune.py, Tier-D13): bit-exact schedule
+    sweeps, keyed ``|var:<id>`` in the ledger) | copy
+    (harness-calibration 1R+1W elementwise scan).
     """
     kw = dict(params or {})
     if dtype is not None:
@@ -151,6 +174,7 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
         # operands spliced into the sliding window), the A/B against the
         # z-ring for the lowest-traffic kind.
         spec = compute[len("stream"):]
+        spec, tune = _parse_tune(spec)
         mesh_zy = shard_all = None
         if "_mesh" in spec:
             spec, meshspec = spec.split("_mesh", 1)
@@ -160,6 +184,18 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
             spec, shard_all = spec[:-len("_shard")], True
         spec, ens = _parse_ens(spec)
         step_unit, tiles = _parse_kspec(spec)
+        variant = None
+        if tune:
+            if not (mesh_zy or shard_all):
+                raise ValueError(
+                    "_tune labels are sharded-only (the variant plumbing "
+                    "rides make_sharded_fused_step)")
+            if tiles is not None:
+                raise ValueError(
+                    "_tune labels take no tile spec (the variant IS the "
+                    "tile geometry)")
+            from mpi_cuda_process_tpu.policy.autotune import tune_variant
+            variant = tune_variant("stream", tune)
         if mesh_zy or shard_all:
             if tiles is not None:
                 raise ValueError("sharded stream labels take no tile spec")
@@ -178,11 +214,13 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
             mesh = make_mesh((mesh_zy[0], mesh_zy[1], 1) if mesh_zy
                              else (n_dev, 1, 1))
             step = make_sharded_fused_step(st, mesh, grid, step_unit,
-                                           kind="stream", ensemble=ens)
+                                           kind="stream", ensemble=ens,
+                                           variant=variant)
             if step is None:
                 raise ValueError(
                     f"untileable sharded stream k={step_unit} for {grid} "
-                    f"on mesh {tuple(mesh.shape.values())}")
+                    f"on mesh {tuple(mesh.shape.values())}"
+                    + (f" under variant {variant.id}" if variant else ""))
             if not str(getattr(step, "_padfree_kind", "")).startswith(
                     "stream"):
                 raise ValueError(
@@ -190,6 +228,13 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
                     f"kernel (got {getattr(step, '_padfree_kind', None)!r})"
                     " — must not price a different kernel under this "
                     "label")
+            if variant and getattr(step, "_kernel_variant", "") \
+                    != variant.id:
+                raise ValueError(
+                    "_tune label did not build the swept variant (got "
+                    f"{getattr(step, '_kernel_variant', None)!r}, want "
+                    f"{variant.id!r}) — must not price the default "
+                    "constants under a variant label")
             if ens and getattr(step, "_ensemble", 0) != ens:
                 raise ValueError(
                     "ens label did not build the batched step — must "
@@ -225,6 +270,7 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
         )
 
         spec = compute[len("rdma"):]
+        spec, tune = _parse_tune(spec)
         mesh_zy = None
         if "_mesh" in spec:
             spec, meshspec = spec.split("_mesh", 1)
@@ -233,6 +279,10 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
         step_unit, tiles = _parse_kspec(spec)
         if tiles is not None:
             raise ValueError("rdma labels take no tile spec")
+        variant = None
+        if tune:
+            from mpi_cuda_process_tpu.policy.autotune import tune_variant
+            variant = tune_variant("rdma", tune)
         n_dev = len(jax.devices())
         need = mesh_zy[0] * mesh_zy[1] if mesh_zy else 2
         if n_dev < need:
@@ -242,11 +292,13 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
         mesh = make_mesh((mesh_zy[0], mesh_zy[1], 1) if mesh_zy
                          else (n_dev, 1, 1))
         step = make_sharded_fused_step(st, mesh, grid, step_unit,
-                                       kind="stream", exchange="rdma")
+                                       kind="stream", exchange="rdma",
+                                       variant=variant)
         if step is None:
             raise ValueError(
                 f"untileable rdma stream k={step_unit} for {grid} on "
-                f"mesh {tuple(mesh.shape.values())}")
+                f"mesh {tuple(mesh.shape.values())}"
+                + (f" under variant {variant.id}" if variant else ""))
         if getattr(step, "_exchange", None) != "rdma" or not str(
                 getattr(step, "_padfree_kind", "")).startswith("stream"):
             raise ValueError(
@@ -262,6 +314,12 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
                 "rdma label built the interpret-emulated exchange "
                 f"({getattr(step, '_rdma_backend', None)!r}) — a "
                 "measurement row needs the compiled pallas-rdma path")
+        if variant and getattr(step, "_kernel_variant", "") != variant.id:
+            raise ValueError(
+                "_tune label did not build the swept variant (got "
+                f"{getattr(step, '_kernel_variant', None)!r}, want "
+                f"{variant.id!r}) — must not price the default ring "
+                "under a variant label")
         mk = lambda: shard_fields(  # noqa: E731
             init_state(st, grid, kind="auto"), mesh, st.ndim)
         return _time_scan(step, mk, grid, steps, reps, step_unit)
@@ -757,6 +815,33 @@ CONFIGS = [
      10, "float32", "stream4_ens8_mesh8x8"),
     ("wave3d_512_f32_stream4_ens8_mesh8x8", "wave3d", (512, 512, 512),
      8, "float32", "stream4_ens8_mesh8x8"),
+    # ── Tier D13: KERNEL-VARIANT sweeps (round 16, policy/autotune.py)
+    # — *_tuneN rows: the same sharded streaming / rdma steps as the
+    # D8/D11 rows, but under the autotuner registry's Nth campaign
+    # variant for the family (1-based into STREAM_SWEEP / RDMA_SWEEP:
+    # stream tune1=bz16y16 tune2=bz8y8; rdma tune1=ring3 tune2=ring4).
+    # A/B against the same-shape default-constant row prices exactly
+    # one swept constant set; the ledger keys these rows |var:<id>
+    # (obs/ledger.baseline_key), so a variant row can never baseline
+    # the default.  Each variant is bit-exact vs the default kernel
+    # (pinned in tests/test_autotune.py) — these rows measure schedule,
+    # never results.
+    ("heat3d_512_f32_stream4_tune1_shard", "heat3d", (512, 512, 512),
+     10, "float32", "stream4_shard_tune1"),
+    ("heat3d_512_f32_stream4_tune2_shard", "heat3d", (512, 512, 512),
+     10, "float32", "stream4_shard_tune2"),
+    ("wave3d_512_f32_stream4_tune1_shard", "wave3d", (512, 512, 512),
+     8, "float32", "stream4_shard_tune1"),
+    ("wave3d_512_f32_stream4_tune2_shard", "wave3d", (512, 512, 512),
+     8, "float32", "stream4_shard_tune2"),
+    ("heat3d_512_f32_rdma4_tune1", "heat3d", (512, 512, 512), 10,
+     "float32", "rdma4_tune1"),
+    ("heat3d_512_f32_rdma4_tune2", "heat3d", (512, 512, 512), 10,
+     "float32", "rdma4_tune2"),
+    ("wave3d_512_f32_rdma4_tune1", "wave3d", (512, 512, 512), 8,
+     "float32", "rdma4_tune1"),
+    ("wave3d_512_f32_rdma4_tune2", "wave3d", (512, 512, 512), 8,
+     "float32", "rdma4_tune2"),
 ]
 
 # Tier-D labels: new large Mosaic compiles.  A hang here is plausibly a
@@ -785,7 +870,11 @@ _RISKY = frozenset(
 # rev 9: the in-kernel remote-DMA exchange (exchange='rdma') — new
 # rdmaK labels exist, and the streaming steppers grew the transport
 # layer (halo.RdmaTransport threading), so older declines retry.
-BUILDER_REV = 10
+# rev 11: kernel-variant plumbing (policy/autotune.py) — new *_tuneN
+# labels exist, remote.py's ring kernel is parameterized over slot
+# count / chunk preference and the streaming builders accept variant
+# tiles through the sharded steppers, so older declines retry.
+BUILDER_REV = 11
 
 
 def _skip_cached(cached):

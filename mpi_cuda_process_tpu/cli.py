@@ -356,6 +356,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "overrides; the decision, its provenance "
                         "(measured vs predicted) and the runner-up "
                         "table land in the manifest as a 'policy' event")
+    p.add_argument("--kernel-variant", default="", metavar="ID",
+                   help="force a kernel-constant variant from the "
+                        "autotuner registry (policy/autotune.py: e.g. "
+                        "ring3/ring4/nc8 sweep the remote-DMA ring "
+                        "depth/chunk geometry, bz16y16/bz8y8/bz16y32 "
+                        "the streaming strip shape).  Schedule-only: "
+                        "every variant is bit-exact vs the default "
+                        "constants.  Needs --fuse-kind stream (+ "
+                        "--exchange rdma for the ring family); an "
+                        "infeasible variant refuses with the named "
+                        "reason instead of silently running the "
+                        "default kernel")
+    p.add_argument("--autotune", action="store_true",
+                   help="measured kernel-constant sweep before the run "
+                        "(policy/autotune.py): probe every feasible "
+                        "variant for this config/backend with short "
+                        "scans and record the winners as ordinary "
+                        "campaign-ledger rows under |var:<id> baseline "
+                        "keys — --auto-policy then resolves the "
+                        "measured winner like any other mode "
+                        "dimension.  Probe order is attribution-"
+                        "driven: comm-bound sweeps ring constants "
+                        "first, compute-bound strip shapes first")
     p.add_argument("--policy-recheck", type=int, default=0, metavar="K",
                    help="with --auto-policy: re-resolve the policy "
                         "every K chunk boundaries and live-migrate the "
@@ -388,6 +411,7 @@ def config_from_args(argv=None) -> RunConfig:
         dump_every=a.dump_every, dump_dir=a.dump_dir,
         mem_check=a.mem_check,
         auto_policy=a.auto_policy, policy_recheck=a.policy_recheck,
+        kernel_variant=a.kernel_variant, autotune=a.autotune,
         supervise=a.supervise, max_restarts=a.max_restarts,
         restart_backoff=a.restart_backoff,
         supervise_stall_s=a.supervise_stall_s,
@@ -693,6 +717,16 @@ def build(cfg: RunConfig):
             raise ValueError(
                 "--exchange rdma is guard-frame only (the streaming "
                 "kernels have no periodic wrap path)")
+    variant = None
+    if cfg.kernel_variant:
+        # a forced kernel variant follows the forced-flag contract: an
+        # unknown id or an infeasible (shape, dtype, mesh) combination
+        # raises with the named reason before any build work — the
+        # default-constant kernel is never silently measured under a
+        # variant label
+        from .policy import autotune as autotune_lib
+
+        variant = autotune_lib.resolve_variant(cfg, st)
     if cfg.pipeline and not cfg.fuse:
         # a requested pipeline must never be silently ignored (the
         # forced-flag contract): without temporal blocking there are no
@@ -734,7 +768,8 @@ def build(cfg: RunConfig):
             fused = stepper_lib.make_sharded_temporal_step(
                 st, m, cfg.grid, cfg.fuse, periodic=cfg.periodic,
                 kind=kind, overlap=cfg.overlap, pipeline=cfg.pipeline,
-                exchange=cfg.exchange, ensemble=cfg.ensemble)
+                exchange=cfg.exchange, ensemble=cfg.ensemble,
+                variant=variant)
             if cfg.overlap and fused is not None and \
                     not getattr(fused, "_overlap_active", False):
                 log.warning(
@@ -909,6 +944,22 @@ def run(cfg: RunConfig) -> Tuple:
         cfg = dataclasses.replace(cfg, telemetry=os.path.join(
             trace_lib.default_telemetry_dir(),
             f"serve-{os.getpid()}-{int(time.time())}.jsonl"))
+    if cfg.autotune:
+        # measured kernel-constant sweep BEFORE policy resolution: the
+        # probes land as ordinary ledger rows under |var:<id> baseline
+        # keys, so the --auto-policy resolve below (and every later
+        # run against the same ledger) ranks the measured variants
+        # like any other mode dimension.
+        from .policy import autotune as autotune_lib
+
+        summary = autotune_lib.maybe_autotune(cfg)
+        log.info(
+            "autotune: swept %d variant(s) (%s) -> %s; winner %s",
+            len(summary["swept"]), ",".join(summary["order"]) or "-",
+            summary["ledger"], summary["winner"] or "none")
+        for s in summary["skipped"]:
+            log.info("autotune: skipped %s: %s", s["id"], s["reason"])
+        cfg = dataclasses.replace(cfg, autotune=False)
     decision = None
     if cfg.auto_policy:
         # measurement-driven execution policy: resolve the unset mode
@@ -960,7 +1011,7 @@ def run(cfg: RunConfig) -> Tuple:
             # back to the plain path the retry is promising
             retry_cfg = dataclasses.replace(
                 retry_cfg, fuse=0, fuse_kind="auto", pipeline=False,
-                exchange="ppermute")
+                exchange="ppermute", kernel_variant="")
         if cfg.telemetry:
             # keep the failed run's trace (it recorded the error event);
             # the retry writes its own log next to it
@@ -1085,11 +1136,16 @@ def _emit_static_cost(cfg: RunConfig, st, session) -> None:
     try:
         from .obs import costmodel
 
+        variant = None
+        if cfg.kernel_variant:
+            from .policy import autotune as autotune_lib
+
+            variant = autotune_lib.VARIANTS.get(cfg.kernel_variant)
         session.event("costmodel", **costmodel.static_cost(
             st, cfg.grid, mesh=cfg.mesh, fuse=cfg.fuse,
             fuse_kind=cfg.fuse_kind, periodic=cfg.periodic,
             ensemble=cfg.ensemble, exchange=cfg.exchange,
-            ensemble_mesh=cfg.ensemble_mesh))
+            ensemble_mesh=cfg.ensemble_mesh, variant=variant))
     except Exception:  # noqa: BLE001 — telemetry is never load-bearing
         log.debug("static cost model failed; trace goes without it",
                   exc_info=True)

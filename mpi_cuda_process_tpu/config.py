@@ -73,6 +73,18 @@ class RunConfig:
     # Explicit flags always win and are recorded as overrides in the
     # manifest 'policy' event.
     auto_policy: bool = False
+    # forced kernel variant (policy/autotune.py registry id, e.g.
+    # 'ring4' or 'bz16y16'): run the streaming/rdma kernels under that
+    # variant's swept constants — schedule changes, results never do;
+    # an infeasible variant raises with the named reason (forced-flag
+    # contract).  "" = default constants.
+    kernel_variant: str = ""
+    # measured kernel-constant sweep (policy/autotune.py): before the
+    # run, probe every feasible variant for this config into ordinary
+    # ledger rows under |var:<id> baseline keys, so --auto-policy can
+    # resolve the measured winner.  The run itself then proceeds
+    # normally.
+    autotune: bool = False
     # >0 with --auto-policy: re-resolve the policy every K chunk
     # boundaries and, when the winner's ADOPTABLE mode fields changed,
     # live-migrate the run to it (parallel/reshard.py collective

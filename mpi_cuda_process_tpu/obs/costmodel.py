@@ -137,23 +137,30 @@ def rdma_stats_from_jaxpr(closed) -> Dict[str, int]:
 
 
 def _rdma_sites(stencil, local: Sequence[int], m: int,
-                counts: Sequence[int]) -> List[Dict[str, Any]]:
+                counts: Sequence[int], nslots: int = 0,
+                prefer_nc: int = 0) -> List[Dict[str, Any]]:
     """The per-field ring-exchange sites of one slab-kind pass under
     ``exchange="rdma"``, with their chunk geometry — read from the SAME
     ``remote.pick_chunks`` the kernel builder uses, so the analytic DMA
     counts cross-check against the kernel's actual grid by
     construction.  Mirrors ``halo.exchange_slabs_2axis``: one call per
     z-slab pair, one per y-slab pair, two per corner set (the two-pass
-    composition exchanges zlo and zhi separately along y)."""
+    composition exchanges zlo and zhi separately along y).
+    ``nslots``/``prefer_nc`` (0 = kernel defaults) re-pin the table
+    under an rdma kernel variant's ring geometry (policy/autotune.py),
+    so kernel and model read the same constants."""
     from ..ops.pallas.remote import ring_exchange_stats
 
     lz, ly, lx = local
+    kw = {"nslots": nslots or None, "prefer_nc": prefer_nc}
     sites = []
     if counts[0] > 1:
-        sites.append(ring_exchange_stats((m, ly, lx), stencil.dtype))
+        sites.append(ring_exchange_stats((m, ly, lx), stencil.dtype,
+                                         **kw))
     if counts[1] > 1:
-        sites.append(ring_exchange_stats((lz, m, lx), stencil.dtype))
-        corner = ring_exchange_stats((m, m, lx), stencil.dtype)
+        sites.append(ring_exchange_stats((lz, m, lx), stencil.dtype,
+                                         **kw))
+        corner = ring_exchange_stats((m, m, lx), stencil.dtype, **kw)
         sites += [corner, dict(corner)]
     return sites
 
@@ -167,6 +174,7 @@ def comm_stats(
     periodic: bool = False,
     exchange: str = "ppermute",
     batch: int = 1,
+    variant=None,
 ) -> Optional[Dict[str, Any]]:
     """Analytic ppermute rounds + bytes per device, or None (unsharded).
 
@@ -245,7 +253,16 @@ def comm_stats(
         z_sharded = counts[0] > 1
         z_bytes = m * ly * lx * item
         if rdma:
-            rdma_sites = _rdma_sites(stencil, local, m, counts)
+            # an rdma-family kernel variant (policy/autotune.py) changes
+            # the ring geometry the kernel builds — the chunk table must
+            # be read under the same constants or the crosscheck would
+            # compare different schedules
+            v_nslots = int(getattr(variant, "nslots", 0) or 0) \
+                if getattr(variant, "family", "") == "rdma" else 0
+            v_nc = int(getattr(variant, "prefer_nc", 0) or 0) \
+                if getattr(variant, "family", "") == "rdma" else 0
+            rdma_sites = _rdma_sites(stencil, local, m, counts,
+                                     nslots=v_nslots, prefer_nc=v_nc)
         if z_sharded:
             rounds += nf * 2
             ici += nf * 2 * z_bytes
@@ -318,6 +335,7 @@ def rdma_crosscheck(
     mesh: Sequence[int],
     fuse: int,
     periodic: bool = False,
+    variant=None,
 ) -> Optional[Dict[str, Any]]:
     """Analytic rdma DMA count vs a TRACED compiled rdma step.
 
@@ -331,7 +349,7 @@ def rdma_crosscheck(
     match on traceable meshes.
     """
     cs = comm_stats(stencil, grid, mesh, fuse=fuse, fuse_kind="stream",
-                    periodic=periodic, exchange="rdma")
+                    periodic=periodic, exchange="rdma", variant=variant)
     if cs is None or "rdma_dma_per_pass" not in cs:
         return None
     try:
@@ -342,7 +360,7 @@ def rdma_crosscheck(
         step = make_sharded_fused_step(
             stencil, mesh_obj, tuple(int(g) for g in grid), int(fuse),
             interpret=False, kind="stream", periodic=periodic,
-            exchange="rdma")
+            exchange="rdma", variant=variant)
         if step is None:
             return None
         abstract = tuple(
@@ -414,6 +432,7 @@ def static_cost(
     ici_gbs: float = V5E_ICI_GBS,
     exchange: str = "ppermute",
     ensemble_mesh: int = 0,
+    variant=None,
 ) -> Dict[str, Any]:
     """The manifest's static cost block: counters + roofline prediction.
 
@@ -423,6 +442,9 @@ def static_cost(
     claim (exchange hidden behind interior compute — step time is the
     HBM bound alone) and ``serial`` the unhidden schedule; the measured
     number landing between them is the overlap win, quantified.
+    ``variant`` (a ``policy.autotune.KernelVariant`` or None) re-pins
+    the rdma chunk tables and the traced cross-check under that
+    variant's ring geometry — model and kernel read the same constants.
     """
     grid = tuple(int(g) for g in grid)
     local = _local_shape(grid, mesh)
@@ -434,7 +456,8 @@ def static_cost(
     members = (total_members // max(1, int(ensemble_mesh))
                if ensemble else 1)
     comm = comm_stats(stencil, grid, mesh, fuse=fuse, fuse_kind=fuse_kind,
-                      periodic=periodic, exchange=exchange, batch=members)
+                      periodic=periodic, exchange=exchange, batch=members,
+                      variant=variant)
     flops = members * step_flops(stencil, local, periodic=periodic)
     hbm_b = hbm_bytes_per_step(stencil, local, fuse=fuse, batch=members)
     t_hbm_ms = hbm_b / (hbm_gbs * 1e9) * 1e3
@@ -486,7 +509,8 @@ def static_cost(
             # rides every rdma manifest so obs_report attributes the
             # in-kernel traffic (None when this box can't host the mesh)
             out["rdma_crosscheck"] = rdma_crosscheck(
-                stencil, grid, mesh, fuse, periodic=periodic)
+                stencil, grid, mesh, fuse, periodic=periodic,
+                variant=variant)
         except Exception:  # noqa: BLE001 — never block a manifest write
             out["rdma_crosscheck"] = None
     return out

@@ -99,9 +99,13 @@ def baseline_key(row: Dict[str, Any]) -> str:
     ``ens=8`` row aggregates 8 members' throughput, so judging it
     against a single-sim baseline (or vice versa) would read the batch
     multiplier as an 8x regression/improvement — across ensemble sizes
-    the gate reports NO_BASELINE instead.  Mode and ensemble ride the
-    flags only when non-default, so every pre-existing row keeps its
-    historical baseline key byte-for-byte.
+    the gate reports NO_BASELINE instead.  And the KERNEL VARIANT
+    (round 16, policy/autotune.py): a ``|var:<id>`` row runs the same
+    kernel family under swept constants, so it must never baseline a
+    default-constant row (or vice versa) — a variant adoption would
+    otherwise read as a regression of the default.  Mode, ensemble and
+    variant ride the flags only when non-default, so every pre-existing
+    row keeps its historical baseline key byte-for-byte.
     """
     k = row["key"]
     flags = k.get("flags") or {}
@@ -110,6 +114,9 @@ def baseline_key(row: Dict[str, Any]) -> str:
     ens = flags.get("ensemble")
     if ens:
         tail += f"|ens{ens}"
+    var = flags.get("kernel_variant")
+    if var:
+        tail += f"|var:{var}"
     return f"{k['label']}|{k.get('backend')}{tail}"
 
 
@@ -307,6 +314,8 @@ def _flags(run: Dict[str, Any]) -> Dict[str, Any]:
         out["ensemble"] = run["ensemble"]
         if run.get("ensemble_mesh"):
             out["ensemble_mesh"] = run["ensemble_mesh"]
+    if run.get("kernel_variant"):
+        out["kernel_variant"] = run["kernel_variant"]
     return out
 
 
@@ -331,6 +340,8 @@ def _cli_label(run: Dict[str, Any]) -> str:
         parts.append(f"ens{run['ensemble']}")
         if run.get("ensemble_mesh"):
             parts.append(f"ensmesh{run['ensemble_mesh']}")
+    if run.get("kernel_variant"):
+        parts.append(f"var{run['kernel_variant']}")
     return "cli_" + "_".join(p for p in parts if p)
 
 

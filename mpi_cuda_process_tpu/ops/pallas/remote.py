@@ -76,15 +76,24 @@ from .kernels import _VMEM_LIMIT_BYTES
 
 # Ring slots per direction: 2 = the minimum that lets chunk i+1's send
 # overlap chunk i's drain (capacity-2 credit flow control).  The ISSUE's
-# "double-buffered recv slots".
+# "double-buffered recv slots".  This is the DEFAULT only: the kernel
+# variant autotuner (policy/autotune.py) sweeps deeper rings through the
+# ``nslots=`` parameters below, and the credit capacity scales with it.
 _NSLOTS = 2
 
-# Chunk-count ladder, largest first: more chunks = finer send/compute
-# overlap, but every chunk pays a semaphore round-trip.
-_NC_LADDER = (4, 2)
+
+def _nc_ladder(nslots: int) -> Tuple[int, int]:
+    """Chunk-count ladder, largest first: more chunks = finer
+    send/compute overlap, but every chunk pays a semaphore round-trip.
+    The floor is the slot count itself — fewer chunks than slots would
+    leave ring capacity idle — so the ladder scales with the ring depth
+    instead of hardcoding the historical 2-slot ``(4, 2)``."""
+    return (2 * nslots, nslots)
 
 
-def pick_chunks(shape: Tuple[int, ...], itemsize: int) -> Tuple[int, int]:
+def pick_chunks(shape: Tuple[int, ...], itemsize: int,
+                nslots: int = _NSLOTS,
+                prefer_nc: int = 0) -> Tuple[int, int]:
     """``(chunk_axis, nchunks)`` for a slab of ``shape``.
 
     The single source of chunk geometry — the kernel builder AND the
@@ -96,9 +105,18 @@ def pick_chunks(shape: Tuple[int, ...], itemsize: int) -> Tuple[int, int]:
     ``wm_a``); axis 0 offsets are free.  Prefers the sublane axis when
     both qualify (tile-shaped chunks), falls back to a single chunk
     when nothing divides.
+
+    ``nslots`` scales the ladder floor (a deeper ring wants at least as
+    many chunks as slots); ``prefer_nc`` prepends a variant-requested
+    chunk count that still must pass the same divisibility/alignment
+    gates — an autotuner candidate can steer the geometry but never
+    bypass the constraints.  The defaults reproduce the historical
+    ``(4, 2)`` ladder byte-for-byte.
     """
     sub = _sublane(itemsize)
-    for nc in _NC_LADDER:
+    ladder = ((int(prefer_nc),) if prefer_nc else ()) \
+        + _nc_ladder(int(nslots))
+    for nc in ladder:
         for axis in (1, 0):
             ext = int(shape[axis])
             if ext % nc:
@@ -115,13 +133,20 @@ def _chunk_at(ref, axis: int, start, size: int):
     return ref.at[tuple(idx)]
 
 
-def _ring_kernel(nc, axis, csize, remote, *refs):
+def _ring_kernel(nc, axis, csize, nslots, remote, *refs):
     """Both ring directions of one slab pair through the VMEM rings.
 
     ``refs`` = ``[nbr_ids (SMEM int32 (2,))] +`` (remote only) ``[hi,
     lo]`` HBM inputs ``+ [from_left/wire_hi, from_right/wire_lo]`` HBM
     outputs.  Direction 0 sends ``hi`` down-ring (lands as the next
     shard's ``from_left``), direction 1 sends ``lo`` up-ring.
+
+    ``nslots`` is the ring depth per direction (default 2): the
+    in-flight window, the credit capacity, and the scratch/semaphore
+    shapes all derive from it, so the drained-semaphore arithmetic
+    below holds for ANY depth — credits signaled per direction = nc,
+    consumed = max(0, nc - nslots) in the flow-control window plus
+    min(nslots, nc) in the epilogue = nc; sends waited = nc.
     """
     if remote:
         nbr, refs = refs[0], refs[1:]
@@ -133,11 +158,11 @@ def _ring_kernel(nc, axis, csize, remote, *refs):
         def load(d, c):
             return pltpu.make_async_copy(
                 _chunk_at(ins[d], axis, c * csize, csize),
-                send_buf.at[d, c % _NSLOTS],
-                load_sems.at[d, c % _NSLOTS])
+                send_buf.at[d, c % nslots],
+                load_sems.at[d, c % nslots])
 
         def xfer(d, c):
-            slot = c % _NSLOTS
+            slot = c % nslots
             if remote:
                 return pltpu.make_async_remote_copy(
                     src_ref=send_buf.at[d, slot],
@@ -153,9 +178,9 @@ def _ring_kernel(nc, axis, csize, remote, *refs):
 
         def drain(d, c):
             return pltpu.make_async_copy(
-                recv_buf.at[d, c % _NSLOTS],
+                recv_buf.at[d, c % nslots],
                 _chunk_at(outs[d], axis, c * csize, csize),
-                drain_sems.at[d, c % _NSLOTS])
+                drain_sems.at[d, c % nslots])
 
         if remote:
             # Neighbor-readiness barrier: no remote write may land in a
@@ -166,9 +191,9 @@ def _ring_kernel(nc, axis, csize, remote, *refs):
                     bar, 1, device_id=nbr[d],
                     device_id_type=pltpu.DeviceIdType.LOGICAL)
             pltpu.semaphore_wait(bar, 2)
-        # prologue: fill both slots per direction (no credit needed —
-        # both remote recv slots start free)
-        for c in range(min(_NSLOTS, nc)):
+        # prologue: fill the ring per direction (no credit needed —
+        # all remote recv slots start free)
+        for c in range(min(nslots, nc)):
             for d in (0, 1):
                 load(d, c).start()
                 load(d, c).wait()
@@ -187,34 +212,34 @@ def _ring_kernel(nc, axis, csize, remote, *refs):
                     pltpu.semaphore_signal(
                         credit.at[d], 1, device_id=nbr[1 - d],
                         device_id_type=pltpu.DeviceIdType.LOGICAL)
-            if c + _NSLOTS < nc:
+            if c + nslots < nc:
                 for d in (0, 1):
                     if remote:
-                        # capacity-2 flow control: reuse the remote recv
-                        # slot only after its drain was credited, and
-                        # the send slot only after its send left
+                        # capacity-nslots flow control: reuse the remote
+                        # recv slot only after its drain was credited,
+                        # and the send slot only after its send left
                         pltpu.semaphore_wait(credit.at[d], 1)
                         xfer(d, c).wait_send()
-                    load(d, c + _NSLOTS).start()
-                    load(d, c + _NSLOTS).wait()
-                    xfer(d, c + _NSLOTS).start()
+                    load(d, c + nslots).start()
+                    load(d, c + nslots).wait()
+                    xfer(d, c + nslots).start()
         if remote:
             # epilogue: every semaphore must read zero at kernel exit
-            for c in range(max(0, nc - _NSLOTS), nc):
+            for c in range(max(0, nc - nslots), nc):
                 for d in (0, 1):
                     xfer(d, c).wait_send()
             for d in (0, 1):
-                pltpu.semaphore_wait(credit.at[d], min(_NSLOTS, nc))
+                pltpu.semaphore_wait(credit.at[d], min(nslots, nc))
 
     cshape = list(ins[0].shape)
     cshape[axis] = csize
     kwargs = dict(
-        send_buf=pltpu.VMEM((2, _NSLOTS, *cshape), ins[0].dtype),
-        recv_buf=pltpu.VMEM((2, _NSLOTS, *cshape), ins[0].dtype),
-        load_sems=pltpu.SemaphoreType.DMA((2, _NSLOTS)),
-        drain_sems=pltpu.SemaphoreType.DMA((2, _NSLOTS)),
-        send_sems=pltpu.SemaphoreType.DMA((2, _NSLOTS)),
-        recv_sems=pltpu.SemaphoreType.DMA((2, _NSLOTS)),
+        send_buf=pltpu.VMEM((2, nslots, *cshape), ins[0].dtype),
+        recv_buf=pltpu.VMEM((2, nslots, *cshape), ins[0].dtype),
+        load_sems=pltpu.SemaphoreType.DMA((2, nslots)),
+        drain_sems=pltpu.SemaphoreType.DMA((2, nslots)),
+        send_sems=pltpu.SemaphoreType.DMA((2, nslots)),
+        recv_sems=pltpu.SemaphoreType.DMA((2, nslots)),
     )
     if remote:
         kwargs["credit"] = pltpu.SemaphoreType.REGULAR((2,))
@@ -229,6 +254,8 @@ def build_ring_exchange_call(
     interpret: bool,
     collective_id: int = 0,
     chunks: Optional[Tuple[int, int]] = None,
+    nslots: Optional[int] = None,
+    prefer_nc: int = 0,
 ):
     """One ring-exchange ``pallas_call`` for slabs of ``shape``/``dtype``.
 
@@ -245,17 +272,23 @@ def build_ring_exchange_call(
 
     Returns ``(call, meta)``; ``meta`` records the chunk geometry the
     cost model cross-checks (axis, nchunks, chunk/slab bytes, slots).
+    ``nslots``/``prefer_nc`` are the kernel-variant knobs (ring depth
+    and chunk-count preference, policy/autotune.py); the defaults are
+    the historical 2-slot geometry.
     """
     shape = tuple(int(s) for s in shape)
     assert len(shape) == 3, shape
     itemsize = jnp.dtype(dtype).itemsize
+    nslots = int(nslots) if nslots else _NSLOTS
     if chunks is None:
-        chunks = pick_chunks(shape, itemsize)
+        chunks = pick_chunks(shape, itemsize, nslots=nslots,
+                             prefer_nc=prefer_nc)
     axis, nc = chunks
     assert shape[axis] % nc == 0, (shape, chunks)
     csize = shape[axis] // nc
 
-    kernel = functools.partial(_ring_kernel, nc, axis, csize, remote)
+    kernel = functools.partial(_ring_kernel, nc, axis, csize, nslots,
+                               remote)
     in_specs = [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
     if remote:
         in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + in_specs
@@ -277,7 +310,7 @@ def build_ring_exchange_call(
         "dtype": str(jnp.dtype(dtype)),
         "chunk_axis": axis,
         "nchunks": nc,
-        "nslots": _NSLOTS,
+        "nslots": nslots,
     }
     meta["slab_bytes"] = shape[0] * shape[1] * shape[2] * itemsize
     meta["chunk_bytes"] = meta["slab_bytes"] // nc
@@ -287,19 +320,24 @@ def build_ring_exchange_call(
     return call, meta
 
 
-def ring_exchange_stats(shape: Tuple[int, ...], dtype) -> dict:
+def ring_exchange_stats(shape: Tuple[int, ...], dtype,
+                        nslots: Optional[int] = None,
+                        prefer_nc: int = 0) -> dict:
     """Chunk geometry + per-call DMA/byte counts WITHOUT building the
     kernel — the analytic half of the costmodel cross-check, guaranteed
-    consistent with the kernel because both read :func:`pick_chunks`."""
+    consistent with the kernel because both read :func:`pick_chunks`
+    (same ``nslots``/``prefer_nc`` variant knobs as the builder)."""
     shape = tuple(int(s) for s in shape)
     itemsize = jnp.dtype(dtype).itemsize
-    axis, nc = pick_chunks(shape, itemsize)
+    nslots = int(nslots) if nslots else _NSLOTS
+    axis, nc = pick_chunks(shape, itemsize, nslots=nslots,
+                           prefer_nc=prefer_nc)
     slab_bytes = shape[0] * shape[1] * shape[2] * itemsize
     return {
         "shape": list(shape),
         "chunk_axis": axis,
         "nchunks": nc,
-        "nslots": _NSLOTS,
+        "nslots": nslots,
         "chunk_bytes": slab_bytes // nc,
         "remote_dma_per_call": 2 * nc,
         "ici_bytes_per_call": 2 * slab_bytes,

@@ -82,15 +82,24 @@ class RdmaTransport:
     ``"pallas-rdma"`` when the remote kernel runs, ``"interpret-
     emulated"`` when the loopback kernel + ``all_gather`` ring shift
     stands in (see ``ops/pallas/compat.interpret_remote_dma_supported``).
+
+    ``nslots``/``prefer_nc`` are the rdma kernel-variant knobs
+    (policy/autotune.py): the ring depth (= credit capacity) and the
+    chunk-count preference handed to every exchange site this transport
+    builds.  Zero means the kernel defaults — the schedule changes,
+    the exchanged bytes never do.
     """
 
-    def __init__(self, mesh, interpret: bool):
+    def __init__(self, mesh, interpret: bool, nslots: int = 0,
+                 prefer_nc: int = 0):
         from ..ops.pallas.compat import interpret_remote_dma_supported
 
         self.mesh = mesh
         self.interpret = bool(interpret)
         self.emulate = self.interpret and not interpret_remote_dma_supported()
         self.backend = "interpret-emulated" if self.emulate else "pallas-rdma"
+        self.nslots = int(nslots)
+        self.prefer_nc = int(prefer_nc)
         self.sites = []  # chunk-geometry meta per built exchange site
         self._next_collective_id = 0
 
@@ -112,7 +121,8 @@ class RdmaTransport:
         if self.emulate:
             call, meta = build_ring_exchange_call(
                 hi_slab.shape, hi_slab.dtype, remote=False,
-                interpret=True)
+                interpret=True, nslots=self.nslots,
+                prefer_nc=self.prefer_nc)
             self.sites.append(meta)
             # the loopback kernel runs the full VMEM-ring machinery;
             # the cross-chip hop is the explicit gather-shift below
@@ -130,7 +140,8 @@ class RdmaTransport:
         call, meta = build_ring_exchange_call(
             hi_slab.shape, hi_slab.dtype, remote=True,
             interpret=self.interpret,
-            collective_id=self._collective_id())
+            collective_id=self._collective_id(),
+            nslots=self.nslots, prefer_nc=self.prefer_nc)
         self.sites.append(meta)
         nbr = jnp.stack([neighbor_logical_ids(self.mesh, axis_name, +1),
                          neighbor_logical_ids(self.mesh, axis_name, -1)])

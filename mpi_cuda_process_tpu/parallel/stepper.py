@@ -368,6 +368,7 @@ def make_sharded_fused_step(
     pipeline: bool = False,
     exchange: Optional[str] = None,
     ensemble: int = 0,
+    variant=None,
 ):
     """Temporal blocking under domain decomposition: k steps per exchange.
 
@@ -536,6 +537,21 @@ def make_sharded_fused_step(
                 "(the VMEM-ring kernels the remote DMA feeds): force "
                 "--fuse-kind stream, or use --exchange ppermute for "
                 f"kind={kind!r}")
+    if variant is not None:
+        # Kernel variants (policy/autotune.py) ride the streaming kernel
+        # family only — the swept constants (ring depth, chunk geometry,
+        # strip shape) are streaming/rdma kernel knobs, and a forced
+        # variant never silently runs the default-constant kernel.
+        if kind != "stream":
+            raise ValueError(
+                f"kernel variant {variant.id!r} rides the streaming "
+                "kernel family: force --fuse-kind stream (or drop "
+                f"--kernel-variant for kind={kind!r})")
+        if variant.family == "rdma" and exchange != "rdma":
+            raise ValueError(
+                f"kernel variant {variant.id!r} sweeps the remote-DMA "
+                "ring constants and needs --exchange rdma (or pick a "
+                "stream-family variant)")
     if pipeline and periodic:
         # A requested pipeline must never silently fall back (the forced-
         # kind contract): periodic cannot host the slab-carry scan — the
@@ -576,12 +592,12 @@ def make_sharded_fused_step(
                 stencil, mesh, global_shape, local_shape, axis_names,
                 counts, k, interpret, periodic, overlap=overlap,
                 stream=True, pipeline=pipeline, exchange=exchange,
-                ensemble=ensemble)
+                ensemble=ensemble, variant=variant)
         return _make_zslab_padfree_step(
             stencil, mesh, global_shape, local_shape, axis_names, counts,
             k, build_stream_sharded_call, (1, 1), interpret, periodic,
             overlap=overlap, pipeline=pipeline, exchange=exchange,
-            ensemble=ensemble)
+            ensemble=ensemble, variant=variant)
     forced_padfree = kind == "padfree"
     if forced_padfree:
         padfree = True
@@ -763,7 +779,7 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
                              axis_names, counts, k, build_call, layout,
                              interpret, periodic, overlap=False,
                              pipeline=False, exchange="ppermute",
-                             ensemble=0):
+                             ensemble=0, variant=None):
     """shard_map wrapper for the z-slab pad-free fused kernels: width-m
     slab exchange (no concatenation, no padded copy), slabs handed to the
     kernel as operands, frame from SMEM origin scalars.  ``layout`` is
@@ -792,8 +808,14 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
     n_core, n_slab = layout
     m = k * _halo_per_micro(stencil)
     gshape = tuple(int(g) for g in global_shape)
+    build_kw = {}
+    if variant is not None and variant.tiles:
+        # stream-family block-shape override — only the streaming builder
+        # (layout (1, 1)) accepts tiles; other layouts never see variants
+        # (make_sharded_fused_step rejects them before dispatch)
+        build_kw["tiles"] = variant.tiles
     built = build_call(stencil, local_shape, gshape, k,
-                       interpret=interpret, periodic=periodic)
+                       interpret=interpret, periodic=periodic, **build_kw)
     if built is None:
         return None
     call, m_built, nfields = built
@@ -810,7 +832,11 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
 
         transport = RdmaTransport(
             mesh, _interpret_default() if interpret is None
-            else bool(interpret))
+            else bool(interpret),
+            nslots=variant.nslots if variant is not None
+            and variant.family == "rdma" else 0,
+            prefer_nc=variant.prefer_nc if variant is not None
+            and variant.family == "rdma" else 0)
 
     shells = None
     if overlap and counts[0] > 1:
@@ -840,6 +866,7 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
         step = _member_shard_map(local_step, mesh, 3, ensemble)
         step._padfree_kind = kind_name
         step._ensemble = int(ensemble)
+        step._kernel_variant = variant.id if variant is not None else ""
         return _attach_exchange(step, exchange, transport)
 
     Lz = local_shape[0]
@@ -949,6 +976,7 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
                                 interior_step=interior_sm)
         step._padfree_kind = kind_name
         step._ensemble = int(ensemble)
+        step._kernel_variant = variant.id if variant is not None else ""
         return _attach_exchange(step, exchange, transport)
 
     def local_step_overlap(fields: Fields) -> Fields:
@@ -997,6 +1025,7 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
     )
     step._padfree_kind = kind_name
     step._ensemble = int(ensemble)
+    step._kernel_variant = variant.id if variant is not None else ""
     return _attach_exchange(step, exchange, transport)
 
 
@@ -1004,7 +1033,7 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
                               axis_names, counts, k, interpret, periodic,
                               overlap=False, stream=False,
                               pipeline=False, exchange="ppermute",
-                              ensemble=0):
+                              ensemble=0, variant=None):
     """shard_map wrapper for the 2-AXIS pad-free fused kernels
     (y-sharded and y+z-sharded meshes): width-m slab exchange on both
     wall axes plus the four corner pieces by two-pass composition
@@ -1056,8 +1085,10 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
         from ..ops.pallas.streamfused import build_stream_2axis_call
 
         kind_name = "stream_yz"
+        tiles = (variant.tiles if variant is not None and variant.tiles
+                 else None)
         built = build_stream_2axis_call(stencil, local_shape, gshape, k,
-                                        interpret=interpret,
+                                        tiles=tiles, interpret=interpret,
                                         periodic=periodic)
     else:
         kind_name = "yzslab"
@@ -1087,7 +1118,11 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
 
         transport = RdmaTransport(
             mesh, _interpret_default() if interpret is None
-            else bool(interpret))
+            else bool(interpret),
+            nslots=variant.nslots if variant is not None
+            and variant.family == "rdma" else 0,
+            prefer_nc=variant.prefer_nc if variant is not None
+            and variant.family == "rdma" else 0)
 
     shells = None
     if overlap and sharded_axes:
@@ -1143,6 +1178,7 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
         step = _member_shard_map(local_step, mesh, 3, ensemble)
         step._padfree_kind = kind_name
         step._ensemble = int(ensemble)
+        step._kernel_variant = variant.id if variant is not None else ""
         return _attach_exchange(step, exchange, transport)
 
     Lz, Ly = local_shape[0], local_shape[1]
@@ -1267,6 +1303,7 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
                                 interior_step=interior_sm)
         step._padfree_kind = kind_name
         step._ensemble = int(ensemble)
+        step._kernel_variant = variant.id if variant is not None else ""
         return _attach_exchange(step, exchange, transport)
 
     def local_step_overlap(fields: Fields) -> Fields:
@@ -1300,6 +1337,7 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
     )
     step._padfree_kind = kind_name
     step._ensemble = int(ensemble)
+    step._kernel_variant = variant.id if variant is not None else ""
     return _attach_exchange(step, exchange, transport)
 
 
@@ -1468,6 +1506,7 @@ def make_sharded_temporal_step(
     pipeline: bool = False,
     exchange: Optional[str] = None,
     ensemble: int = 0,
+    variant=None,
 ):
     """Temporal blocking under decomposition, any dimensionality.
 
@@ -1495,6 +1534,12 @@ def make_sharded_temporal_step(
     kinds, and periodic wrap raise with the reason.
     """
     if stencil.ndim == 2:
+        if variant is not None:
+            raise ValueError(
+                "kernel variants are 3D-only: the 2D whole-local-block "
+                "stepper has no streaming kind whose constants a "
+                "variant could sweep — drop --kernel-variant for 2D "
+                "grids")
         if pipeline:
             raise ValueError(
                 "pipeline=True is 3D-only: the 2D whole-local-block "
@@ -1512,4 +1557,5 @@ def make_sharded_temporal_step(
     return make_sharded_fused_step(
         stencil, mesh, global_shape, k, interpret=interpret,
         periodic=periodic, kind=kind, overlap=overlap,
-        pipeline=pipeline, exchange=exchange, ensemble=ensemble)
+        pipeline=pipeline, exchange=exchange, ensemble=ensemble,
+        variant=variant)

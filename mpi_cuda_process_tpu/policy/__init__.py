@@ -9,6 +9,13 @@ exchange/ensemble keying respected) and falls back to the
 ``--auto-policy`` flag and the serving engine's submit path both resolve
 through :func:`policy.select.resolve`; explicit mode flags always win
 and are recorded as overrides in the manifest ``policy`` event.
+
+``policy.autotune`` (ISSUE 16, ROADMAP item 4) extends the policy
+space below the mode level: measured sweeps over the Pallas kernels'
+own constants (remote-DMA ring depth/chunk geometry, streaming strip
+shape) as first-class :class:`policy.autotune.KernelVariant` records,
+probed into ordinary ledger rows under ``|var:<id>`` baseline keys and
+resolved by the same ``select.resolve`` machinery.
 """
 
 from .select import (  # noqa: F401

@@ -33,7 +33,12 @@ the grid with locals no thinner than the halo slab, ensemble-axis
 repackings when ``--ensemble`` is set (member divisors of the device
 count), and overlap/fused variants where legal.  ``--exchange rdma``
 and ``--pipeline`` are never *proposed* (they are TPU fused-path
-specials) but explicitly-passed values are respected and keyed.
+specials) but explicitly-passed values are respected and keyed.  Mode
+combinations that host the streaming kernels additionally propose every
+feasible KERNEL VARIANT from the autotuner registry
+(``policy/autotune.py``): measured ``|var:<id>`` ledger rows — the
+rows ``--autotune`` writes — rank against the default-constant rows
+under the same categorical measured-beats-predicted rule.
 
 Mid-flight rechecks (``--policy-recheck``) pass ``adoptable=True``:
 ``fuse`` is then additionally locked, because the fused step width is
@@ -68,17 +73,23 @@ log = logging.getLogger("mpi_cuda_process_tpu.policy")
 
 #: The execution-mode fields ``--auto-policy`` may resolve.  Everything
 #: else on RunConfig (grid, dtype, cadences, lifecycle) is the problem
-#: statement, not the execution strategy.
+#: statement, not the execution strategy.  ``kernel_variant`` (round 16,
+#: policy/autotune.py) is the sub-mode dimension: the streaming/rdma
+#: kernels' own swept constants, resolved exactly like mesh — measured
+#: ``|var:<id>`` rows beat predictions, an explicit --kernel-variant is
+#: locked.
 MODE_FIELDS: Tuple[str, ...] = ("mesh", "ensemble_mesh", "fuse",
                                 "fuse_kind", "overlap", "pipeline",
-                                "exchange")
+                                "exchange", "kernel_variant")
 
 #: Mode fields a mid-flight recheck may change.  ``fuse`` is excluded:
 #: it is the step-accounting unit (steps per runner call) fixed when
-#: the chunk loop started.
+#: the chunk loop started.  ``kernel_variant`` is adoptable: it changes
+#: only the compiled schedule (bit-exact by the autotuner contract),
+#: never the step-accounting unit or the sharding.
 ADOPTABLE_FIELDS: Tuple[str, ...] = ("mesh", "ensemble_mesh",
                                      "fuse_kind", "overlap", "pipeline",
-                                     "exchange")
+                                     "exchange", "kernel_variant")
 
 
 def _field_default(name: str) -> Any:
@@ -211,6 +222,14 @@ def _valid(c: RunConfig, n_dev: int, backend: str) -> bool:
     if c.exchange != "ppermute" and not (c.fuse and sharded
                                          and backend == "tpu"):
         return False
+    if c.kernel_variant:
+        # a variant candidate must be feasible for this exact (shape,
+        # dtype, mesh, exchange) — the autotuner's validator is the
+        # arbiter (sublane alignment, VMEM budget, family prereqs)
+        from . import autotune as autotune_lib
+
+        if autotune_lib.variant_for_config(c) is None:
+            return False
     return True
 
 
@@ -254,6 +273,21 @@ def candidates(cfg: RunConfig, backend: str,
                     modes_list.append({**base, "fuse": fuse_k})
                     modes_list.append({**base, "fuse": fuse_k,
                                        "overlap": True})
+    if "kernel_variant" not in locked:
+        # the kernel-variant dimension (policy/autotune.py): for every
+        # mode combination that hosts variants (streaming fused kernels
+        # under a mesh), also propose each registry variant feasible
+        # for its family — measured |var:<id> rows then outrank the
+        # default exactly like a measured mesh outranks a prediction
+        from . import autotune as autotune_lib
+
+        for d in list(modes_list):
+            probe = _apply(cfg, locked, d)
+            if not (probe.fuse and probe.fuse_kind == "stream"
+                    and probe.mesh) or probe.kernel_variant:
+                continue
+            for vid in autotune_lib.sweep_ids(probe):
+                modes_list.append({**d, "kernel_variant": vid})
     out: List[RunConfig] = []
     seen: Set[Tuple[Any, ...]] = set()
     for i, modes in enumerate(modes_list):
@@ -287,11 +321,17 @@ def _predict(c: RunConfig, st: Any, backend: str) -> Optional[float]:
         return None
     if c.fuse and backend != "tpu":
         return None  # Pallas temporal blocking does not run off-TPU
+    variant = None
+    if c.kernel_variant:
+        from . import autotune as autotune_lib
+
+        variant = autotune_lib.VARIANTS.get(c.kernel_variant)
     try:
         cost = costmodel.static_cost(
             st, c.grid, mesh=c.mesh, fuse=c.fuse, fuse_kind=c.fuse_kind,
             periodic=c.periodic, ensemble=c.ensemble,
-            exchange=c.exchange, ensemble_mesh=c.ensemble_mesh)
+            exchange=c.exchange, ensemble_mesh=c.ensemble_mesh,
+            variant=variant)
         roof = cost["roofline"]
         key = ("predicted_mcells_per_s_overlapped" if c.overlap
                else "predicted_mcells_per_s_serial")

@@ -429,3 +429,21 @@ def check_budget(
                if ensemble else "")
             + "; --mem-check warn overrides this guard.")
     return total, parts
+
+
+def ring_vmem_bytes(slab_shape: Sequence[int], itemsize: int,
+                    nslots: int, nchunks: int) -> int:
+    """VMEM live bytes of one remote-DMA ring-exchange call under a
+    kernel variant's ring geometry (``ops/pallas/remote.py``).
+
+    The kernel stages both ring directions through a send ring AND a
+    recv ring of ``nslots`` chunk-sized slots each, so the live set is
+    ``2 (dirs) * 2 (send+recv) * nslots * chunk_bytes``.  The variant
+    autotuner (policy/autotune.py) validates every swept ring depth /
+    chunk-count candidate against this figure and the kernel VMEM limit
+    BEFORE any probe runs — a candidate that would overflow VMEM is
+    rejected with a named reason, never compiled.
+    """
+    slab_bytes = math.prod(int(s) for s in slab_shape) * int(itemsize)
+    chunk_bytes = slab_bytes // max(1, int(nchunks))
+    return 2 * 2 * int(nslots) * chunk_bytes

@@ -121,6 +121,8 @@ def _header_lines(status) -> list:
         extra.append(f"fuse={run['fuse']}({run.get('fuse_kind', 'auto')})")
     if run.get("exchange") and run.get("exchange") != "ppermute":
         extra.append(f"exchange={run['exchange']}")
+    if run.get("kernel_variant"):
+        extra.append(f"variant={run['kernel_variant']}")
     extra += flags
     if extra:
         lines.append("      " + "  ".join(extra))
@@ -340,9 +342,9 @@ def _policy_lines(status) -> list:
     decision = pol.get("decision") or {}
     mode_bits = []
     for k in ("mesh", "ensemble_mesh", "fuse", "fuse_kind", "overlap",
-              "pipeline", "exchange"):
+              "pipeline", "exchange", "kernel_variant"):
         v = decision.get(k)
-        if v in (None, 0, False, [], "auto", "ppermute"):
+        if v in (None, 0, False, [], "auto", "ppermute", ""):
             continue
         mode_bits.append(f"{k}={'x'.join(map(str, v)) if isinstance(v, list) else v}")
     val = pol.get("value")

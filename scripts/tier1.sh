@@ -465,6 +465,69 @@ fi
 timeout -k 10 120 python scripts/perf_gate.py /tmp/_t1_policy/run.jsonl \
   --policy-check --dry --ledger /tmp/_t1_policy/ledger.jsonl \
   > /dev/null || rc=1
+# Kernel-variant autotune smoke (round 20, ISSUE 16): the measured
+# constant sweep end to end on CPU — maybe_autotune probes a 2-variant
+# stream sweep (plus the default) on a tiny grid and lands the rows
+# under |var:<id> baseline keys; a seeded dominating row makes a
+# variant the measured winner, which --auto-policy must resolve into
+# the manifest 'policy' event (and the run must then execute that
+# variant's kernel, bit-exact by the default-tier tests); an injected
+# ledger flip to the OTHER variant must trip perf_gate --policy-check
+# (the variant id rides the cli label, so label equality detects the
+# moved winner), with --dry reporting the same mismatch at exit 0.
+rm -rf /tmp/_t1_tune
+mkdir -p /tmp/_t1_tune
+timeout -k 10 600 python -c "
+import dataclasses, json, os, time
+from cpuforce import force_cpu; force_cpu(2)
+os.environ['OBS_LEDGER_PATH'] = '/tmp/_t1_tune/ledger.jsonl'
+from mpi_cuda_process_tpu import cli
+from mpi_cuda_process_tpu.config import RunConfig
+from mpi_cuda_process_tpu.obs import ledger
+from mpi_cuda_process_tpu.policy import autotune
+from mpi_cuda_process_tpu.policy import select as ps
+base = RunConfig(stencil='heat3d', grid=(96, 32, 128), iters=4,
+                 mesh=(2, 1, 1), fuse=2, fuse_kind='stream')
+summary = autotune.maybe_autotune(base, probe_calls=1,
+                                  ids=['bz16y16', 'bz8y8'])
+assert [s['id'] for s in summary['swept']] \
+    == ['default', 'bz16y16', 'bz8y8'], summary
+rows = ledger.read_rows('/tmp/_t1_tune/ledger.jsonl')
+varkeys = {ledger.baseline_key(r) for r in rows
+           if '|var:' in ledger.baseline_key(r)}
+assert len(varkeys) == 2, varkeys
+def seed(vid, value, path):
+    c = dataclasses.replace(base, kernel_variant=vid)
+    label, _ = ps._ledger_identity(c, 'cpu')
+    ledger.append_rows([ledger.make_row(
+        label, value, source='seed', measured_at=time.time(),
+        backend='cpu', flags=ledger._flags(dataclasses.asdict(c)))],
+        path)
+seed('', 1e6, '/tmp/_t1_tune/ledger.jsonl')
+seed('bz8y8', 9e6, '/tmp/_t1_tune/ledger.jsonl')
+tel = '/tmp/_t1_tune/run.jsonl'
+fields, _ = cli.run(dataclasses.replace(base, auto_policy=True,
+                                        telemetry=tel))
+evs = [json.loads(l) for l in open(tel) if l.strip()]
+pol = [e for e in evs if e['kind'] == 'policy']
+assert pol and pol[-1]['provenance'] == 'measured' \
+    and pol[-1]['decision']['kernel_variant'] == 'bz8y8', pol
+seed('bz16y16', 2e7, '/tmp/_t1_tune/ledger.jsonl')
+print('autotune smoke ok: swept default+2 variants, |var: keys in the'
+      ' ledger, --auto-policy resolved measured winner bz8y8')
+" || rc=1
+timeout -k 10 120 python scripts/obs_report.py /tmp/_t1_tune/run.jsonl \
+  --check > /dev/null || rc=1
+# The injected bz16y16 row moved the winning VARIANT after the
+# recorded decision: the replay must exit nonzero; --dry forces 0.
+if timeout -k 10 120 python scripts/perf_gate.py /tmp/_t1_tune/run.jsonl \
+     --policy-check --ledger /tmp/_t1_tune/ledger.jsonl > /dev/null; then
+  echo 'perf_gate --policy-check must exit nonzero on a variant flip' >&2
+  rc=1
+fi
+timeout -k 10 120 python scripts/perf_gate.py /tmp/_t1_tune/run.jsonl \
+  --policy-check --dry --ledger /tmp/_t1_tune/ledger.jsonl \
+  > /dev/null || rc=1
 # The committed campaign ledger must render in both one-command
 # summary surfaces: obs_report --ledger (best_known + quarantine
 # table) and the terminal monitor's ledger mode.

@@ -1,0 +1,489 @@
+"""policy/autotune.py: measured kernel-variant sweeps (ISSUE 16).
+
+The kernel constants — remote-DMA ring depth / chunk preference,
+streaming ``(bz, by)`` strip geometry — become a measured policy
+dimension.  The contract, pinned:
+
+* **variants change schedule, never results** — every swept variant is
+  bit-exact against the default constants, per stencil x dtype x mesh
+  family (the full product rides the slow tier; one case per family
+  stays in the default tier).
+* **validation before any compile** — an infeasible candidate is
+  rejected with a NAMED reason (sublane misalignment, non-dividing
+  strips, VMEM overflow, prefer_nc that cannot steer the geometry);
+  ``--kernel-variant`` raises that reason, never a silent fallback to
+  the default constants.
+* **ledger identity** — a variant row carries a ``|var:<id>`` baseline
+  key (the ``|ensN`` pattern): it can never baseline a default row
+  (perf_gate says NO_BASELINE across variants), and pre-variant keys
+  stay byte-identical.
+* **policy resolution** — ``select.resolve`` ranks ``|var:`` rows like
+  any measured candidate (measured beats predicted; an explicit
+  ``--kernel-variant`` is locked and recorded as an override).
+* **parameterized chunk geometry** — ``pick_chunks`` /
+  ``ring_exchange_stats`` take the variant knobs, and their defaults
+  reproduce the historical 2-slot ``(4, 2)`` ladder byte-for-byte.
+
+Runs on 8 virtual CPU devices (conftest.py); sharded builds use
+prefix submeshes of 2 or 4 devices.
+"""
+
+import dataclasses
+import importlib.util
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_cuda_process_tpu import cli  # noqa: E402
+from mpi_cuda_process_tpu.config import RunConfig  # noqa: E402
+from mpi_cuda_process_tpu.obs import ledger as ledger_lib  # noqa: E402
+from mpi_cuda_process_tpu.ops.pallas import remote  # noqa: E402
+from mpi_cuda_process_tpu.policy import autotune  # noqa: E402
+from mpi_cuda_process_tpu.policy import select as ps  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(**kw):
+    kw.setdefault("stencil", "heat3d")
+    kw.setdefault("grid", (96, 32, 128))
+    kw.setdefault("mesh", (2, 1, 1))
+    kw.setdefault("fuse", 2)
+    kw.setdefault("fuse_kind", "stream")
+    kw.setdefault("iters", 2)
+    return RunConfig(**kw)
+
+
+def _seed(ledger_path, cfg, value, backend="cpu", source="seed"):
+    """One measured ``ok`` row whose identity matches ``cfg`` exactly."""
+    label, _ = ps._ledger_identity(cfg, backend)
+    ledger_lib.append_rows([ledger_lib.make_row(
+        label, value, source=source, measured_at=time.time(),
+        backend=backend,
+        flags=ledger_lib._flags(dataclasses.asdict(cfg)))], ledger_path)
+    return label
+
+
+def _perf_gate():
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate_autotune_t", os.path.join(_REPO, "scripts",
+                                             "perf_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------- registry / campaign
+
+def test_tune_variant_is_the_campaign_label_contract():
+    """``tuneN`` labels index the sweep tuples 1-based — the registry
+    order is the measure.py Tier-D13 label meaning, append-only."""
+    assert autotune.tune_variant("stream", 1).id == autotune.STREAM_SWEEP[0]
+    assert autotune.tune_variant("stream", 2).id == "bz8y8"
+    assert autotune.tune_variant("rdma", 2).id == "ring4"
+    with pytest.raises(ValueError, match="unknown variant family"):
+        autotune.tune_variant("fused", 1)
+    with pytest.raises(ValueError, match="swept variants"):
+        autotune.tune_variant("stream", len(autotune.STREAM_SWEEP) + 1)
+    with pytest.raises(ValueError, match="swept variants"):
+        autotune.tune_variant("rdma", 0)
+
+
+def test_registry_families_and_tiles():
+    for v in autotune.VARIANTS.values():
+        assert v.family in ("rdma", "stream"), v
+        assert v.id in autotune.STREAM_SWEEP + autotune.RDMA_SWEEP
+    assert autotune.VARIANTS["bz16y32"].tiles == (16, 32)
+    assert autotune.VARIANTS["ring3"].tiles is None
+
+
+# ------------------------------------------- validation: named reasons
+
+@pytest.mark.parametrize("kw,fragment", [
+    (dict(fuse=0, fuse_kind="auto"), "explicit --fuse"),
+    (dict(fuse_kind="auto"), "streaming kernel family"),
+    (dict(mesh=()), "needs --mesh"),
+    (dict(grid=(96, 32, 128), mesh=(1, 1, 2)), "x-sharded"),
+])
+def test_family_prerequisites_named(kw, fragment):
+    cfg = _cfg(**kw)
+    ok, reason = autotune.validate_variant(
+        autotune.VARIANTS["bz16y16"], cfg)
+    assert not ok and fragment in reason, reason
+
+
+def test_2d_grids_have_no_variants():
+    cfg = RunConfig(stencil="heat2d", grid=(64, 64), mesh=(2, 1),
+                    fuse=2, fuse_kind="stream")
+    ok, reason = autotune.validate_variant(
+        autotune.VARIANTS["bz16y16"], cfg)
+    assert not ok and "3D" in reason
+
+
+def test_rdma_variant_needs_rdma_exchange():
+    ok, reason = autotune.validate_variant(autotune.VARIANTS["ring3"],
+                                           _cfg())
+    assert not ok and "--exchange rdma" in reason
+
+
+def test_sublane_misaligned_by_rejected_bf16():
+    """by=8 under bf16 (sublane tile 16) is named, not silently run."""
+    ok, reason = autotune.validate_variant(
+        autotune.VARIANTS["bz8y8"], _cfg(dtype="bfloat16"))
+    assert not ok and "sublane" in reason and "by=8" in reason
+
+
+def test_non_dividing_bz_rejected():
+    cfg = _cfg(grid=(80, 32, 128))  # local Z = 40, not a multiple of 16
+    ok, reason = autotune.validate_variant(
+        autotune.VARIANTS["bz16y16"], cfg)
+    assert not ok and "does not divide local Z=40" in reason
+
+
+def test_vmem_overflow_rejected_by_name():
+    """A ring deep enough to blow the kernel VMEM budget is rejected
+    with the byte arithmetic in the reason, before any compile."""
+    deep = autotune.KernelVariant(id="ring4096", family="rdma",
+                                  nslots=4096)
+    cfg = _cfg(grid=(96, 64, 128), exchange="rdma")
+    ok, reason = autotune.validate_variant(deep, cfg)
+    assert not ok and "VMEM overflow" in reason and "4096" in reason
+
+
+def test_prefer_nc_that_cannot_steer_rejected():
+    """prefer_nc that no chunkable axis honors would silently run the
+    default geometry — named rejection instead (z-only bf16: the wm
+    slab's sublane axis can't host 8 tile-aligned chunks)."""
+    cfg = _cfg(grid=(96, 64, 128), exchange="rdma", dtype="bfloat16")
+    ok, reason = autotune.validate_variant(autotune.VARIANTS["nc8"], cfg)
+    assert not ok and "prefer_nc=8" in reason
+
+
+def test_resolve_variant_forced_flag_contract():
+    with pytest.raises(ValueError, match="unknown"):
+        autotune.resolve_variant(_cfg(kernel_variant="nope"))
+    with pytest.raises(ValueError, match="sublane"):
+        autotune.resolve_variant(_cfg(kernel_variant="bz8y8",
+                                      dtype="bfloat16"))
+    v = autotune.resolve_variant(_cfg(kernel_variant="bz8y8"))
+    assert v.tiles == (8, 8)
+
+
+def test_variant_for_config_is_a_pruning_predicate():
+    assert autotune.variant_for_config(_cfg(kernel_variant="")) is None
+    assert autotune.variant_for_config(
+        _cfg(kernel_variant="bz8y8", dtype="bfloat16")) is None
+    v = autotune.variant_for_config(_cfg(kernel_variant="bz16y16"))
+    assert v is autotune.VARIANTS["bz16y16"]
+
+
+def test_cli_build_raises_named_reason():
+    """--kernel-variant surfaces the named reason through build()."""
+    with pytest.raises(ValueError, match="sublane"):
+        cli.build(_cfg(kernel_variant="bz8y8", dtype="bfloat16"))
+
+
+# ------------------------------- chunk-geometry parameterization pins
+
+def test_nc_ladder_scales_with_ring_depth():
+    assert remote._nc_ladder(2) == (4, 2)   # the historical ladder
+    assert remote._nc_ladder(3) == (6, 3)
+    assert remote._nc_ladder(4) == (8, 4)
+
+
+def test_pick_chunks_defaults_reproduce_historical_ladder():
+    """No-knob calls are byte-for-byte the pre-variant behavior."""
+    for slab in [(2, 32, 128), (2, 64, 128), (48, 2, 128),
+                 (2, 2, 128), (2, 30, 128), (3, 7, 128)]:
+        assert remote.pick_chunks(slab, 4) == \
+            remote.pick_chunks(slab, 4, nslots=2, prefer_nc=0)
+    assert remote.pick_chunks((2, 32, 128), 4) == (1, 4)
+    assert remote.pick_chunks((2, 2, 128), 4) == (0, 2)
+    assert remote.pick_chunks((3, 7, 128), 4) == (0, 1)  # nothing divides
+
+
+def test_pick_chunks_variant_knobs():
+    # an honored preference leads the ladder...
+    assert remote.pick_chunks((2, 64, 128), 4, prefer_nc=8) == (1, 8)
+    # ...an impossible one falls back to the same gates, never bypasses
+    assert remote.pick_chunks((2, 32, 128), 4, prefer_nc=8) == (1, 4)
+    # a deeper ring raises the ladder floor
+    assert remote.pick_chunks((2, 64, 128), 4, nslots=4) == (1, 8)
+
+
+def test_ring_exchange_stats_reads_the_same_knobs():
+    """The analytic half and the kernel builder share pick_chunks, so
+    the stats must move with the variant knobs."""
+    base = remote.ring_exchange_stats((2, 64, 128), "float32")
+    assert base["nslots"] == 2 and base["nchunks"] == 4
+    deep = remote.ring_exchange_stats((2, 64, 128), "float32", nslots=4)
+    assert deep["nslots"] == 4 and deep["nchunks"] == 8
+    assert deep["remote_dma_per_call"] == 16
+    pref = remote.ring_exchange_stats((2, 64, 128), "float32",
+                                      prefer_nc=8)
+    assert pref["nchunks"] == 8
+    # same total bytes regardless of chunking
+    assert deep["ici_bytes_per_call"] == base["ici_bytes_per_call"]
+
+
+# ------------------------------------------- ledger |var: identity
+
+def test_baseline_key_var_dimension():
+    var = ledger_lib.make_row(
+        "cli_heat3d_96x32x128_fuse2_stream_mesh2x1x1_varbz8y8", 10.0,
+        source="autotune", backend="cpu",
+        flags={"fuse": 2, "fuse_kind": "stream",
+               "kernel_variant": "bz8y8"})
+    default = ledger_lib.make_row(
+        "cli_heat3d_96x32x128_fuse2_stream_mesh2x1x1", 10.0,
+        source="autotune", backend="cpu",
+        flags={"fuse": 2, "fuse_kind": "stream"})
+    assert ledger_lib.baseline_key(var).endswith("|var:bz8y8")
+    # pre-variant rows keep their historical key verbatim
+    assert ledger_lib.baseline_key(default) == \
+        "cli_heat3d_96x32x128_fuse2_stream_mesh2x1x1|cpu"
+    assert ledger_lib.baseline_key(var) != ledger_lib.baseline_key(default)
+
+
+def test_cli_label_and_flags_carry_the_variant():
+    cfg = _cfg(kernel_variant="bz8y8")
+    d = dataclasses.asdict(cfg)
+    assert ledger_lib._cli_label(d).endswith("_varbz8y8")
+    assert ledger_lib._flags(d)["kernel_variant"] == "bz8y8"
+    d_def = dataclasses.asdict(_cfg())
+    assert "kernel_variant" not in (ledger_lib._flags(d_def) or {})
+    assert "var" not in ledger_lib._cli_label(d_def).rsplit("_", 1)[-1]
+
+
+def test_perf_gate_no_baseline_across_variants(tmp_path):
+    """A label measured only under the default constants must gate a
+    variant manifest as NO_BASELINE, never REGRESSED — the constants
+    are part of the baseline identity."""
+    judge = _perf_gate().judge
+    label = "cli_heat3d_96x32x128_fuse2_stream_mesh2x1x1"
+    row_def = ledger_lib.make_row(label, 80.0, source="telemetry:/a",
+                                  backend="cpu", flags={"fuse": 2})
+    row_var = ledger_lib.make_row(
+        label + "_varbz8y8", 40.0, source="telemetry:/b", backend="cpu",
+        flags={"fuse": 2, "kernel_variant": "bz8y8"})
+    path = str(tmp_path / "ledger.jsonl")
+    ledger_lib.append_rows([row_def], path)
+    baselines = ledger_lib.best_known(ledger_lib.read_rows(path))
+    verdict, ratio = judge(
+        row_var, baselines.get(ledger_lib.baseline_key(row_var)), 0.10)
+    assert verdict == "NO_BASELINE" and ratio is None
+    # same-variant rows still gate normally
+    verdict_def, _ = judge(
+        dict(row_def, value=40.0),
+        baselines.get(ledger_lib.baseline_key(row_def)), 0.10)
+    assert verdict_def == "REGRESSED"
+
+
+# -------------------------------------------------- sweep ordering
+
+def test_prioritize_sweep_follows_attribution():
+    comm = {"attribution": "ok", "compute_us": 100.0,
+            "exposed_comm_us": 100.0}
+    compute = {"attribution": "ok", "compute_us": 900.0,
+               "exposed_comm_us": 100.0}
+    fams = ["stream", "rdma"]
+    assert autotune.prioritize_sweep(comm, fams) == ["rdma", "stream"]
+    assert autotune.prioritize_sweep(compute, fams) == ["stream", "rdma"]
+    # no usable attribution: the caller's order stands
+    assert autotune.prioritize_sweep(None, fams) == fams
+    assert autotune.prioritize_sweep({"attribution": "degraded"},
+                                     ["rdma", "stream"]) == \
+        ["rdma", "stream"]
+    # a single family has nothing to reorder
+    assert autotune.prioritize_sweep(comm, ["stream"]) == ["stream"]
+
+
+def test_sweep_ids_lead_with_the_transport_family():
+    ids_pp = autotune.sweep_ids(_cfg())
+    assert ids_pp == list(autotune.STREAM_SWEEP)
+    ids_rdma = autotune.sweep_ids(_cfg(exchange="rdma"))
+    assert ids_rdma == list(autotune.RDMA_SWEEP + autotune.STREAM_SWEEP)
+    comm = {"attribution": "ok", "compute_us": 1.0,
+            "exposed_comm_us": 9.0}
+    assert autotune.sweep_ids(_cfg(exchange="rdma"), comm)[:3] == \
+        list(autotune.RDMA_SWEEP)
+
+
+# ------------------------------------- maybe_autotune sweep mechanics
+
+def test_maybe_autotune_rejects_ineligible_configs():
+    with pytest.raises(ValueError, match="--autotune.*--mesh"):
+        autotune.maybe_autotune(_cfg(mesh=()))
+
+
+def test_maybe_autotune_rows_winner_and_var_keys(tmp_path, monkeypatch):
+    """The sweep records the default + every validated variant as
+    ``|var:`` ledger rows and names the measured winner (probe
+    monkeypatched — the mechanics, not the clock, are under test)."""
+    values = {"": 50.0, "bz16y16": 40.0, "bz8y8": 75.0}
+
+    def fake_probe(cfg, calls):
+        return values[cfg.kernel_variant]
+
+    monkeypatch.setattr(autotune, "_probe_mcells", fake_probe)
+    path = str(tmp_path / "ledger.jsonl")
+    out = autotune.maybe_autotune(_cfg(), ledger_path=path,
+                                  ids=["bz16y16", "bz8y8"])
+    assert [s["id"] for s in out["swept"]] == \
+        ["default", "bz16y16", "bz8y8"]
+    assert out["winner"] == "bz8y8" and out["rows"] == 3
+    assert not out["skipped"]
+    rows = ledger_lib.read_rows(path)
+    keys = {ledger_lib.baseline_key(r) for r in rows}
+    assert {k.split("|var:")[-1] if "|var:" in k else "" for k in keys} \
+        == {"", "bz16y16", "bz8y8"}
+    assert all(r["source"] == "autotune" for r in rows)
+    # the default row's key is the plain cli identity a real run carries
+    label, bk = ps._ledger_identity(_cfg(), "cpu")
+    assert bk in keys and "|var:" not in bk
+
+
+def test_maybe_autotune_skips_with_named_reasons(tmp_path, monkeypatch):
+    """bf16 on a 32-row local Y: every stream variant is infeasible —
+    the sweep still probes the default and names each rejection."""
+    monkeypatch.setattr(autotune, "_probe_mcells", lambda c, n: 1.0)
+    out = autotune.maybe_autotune(
+        _cfg(dtype="bfloat16"), ledger_path=str(tmp_path / "l.jsonl"))
+    assert [s["id"] for s in out["swept"]] == ["default"]
+    reasons = {s["id"]: s["reason"] for s in out["skipped"]}
+    assert "sublane" in reasons["bz8y8"]
+    assert "y-strip window" in reasons["bz16y16"]
+    assert out["winner"] == "default"
+
+
+def test_maybe_autotune_survives_a_failed_probe(tmp_path, monkeypatch):
+    """A crashing candidate is a named sweep result, never an abort."""
+    def flaky(cfg, calls):
+        if cfg.kernel_variant == "bz16y16":
+            raise RuntimeError("probe wedged")
+        return 10.0
+
+    monkeypatch.setattr(autotune, "_probe_mcells", flaky)
+    out = autotune.maybe_autotune(
+        _cfg(), ledger_path=str(tmp_path / "l.jsonl"),
+        ids=["bz16y16", "bz8y8"])
+    assert [s["id"] for s in out["swept"]] == ["default", "bz8y8"]
+    assert any(s["id"] == "bz16y16" and "probe failed" in s["reason"]
+               for s in out["skipped"])
+
+
+# --------------------------------------------- policy resolution
+
+def test_resolve_picks_the_measured_variant_winner(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    cfg = _cfg()
+    _seed(path, cfg, 1e6)
+    _seed(path, dataclasses.replace(cfg, kernel_variant="bz8y8"), 9e6)
+    dec = ps.resolve(cfg, backend="cpu", ledger_path=path, n_devices=2)
+    assert dec.provenance == "measured"
+    assert dec.config.kernel_variant == "bz8y8"
+    assert dec.label.endswith("_varbz8y8")
+    # the requested record keeps the pre-resolution value
+    assert dec.requested["kernel_variant"] == ""
+
+
+def test_explicit_variant_is_locked_and_recorded(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    cfg = _cfg(kernel_variant="bz16y16")
+    assert "kernel_variant" in ps.locked_fields(cfg)
+    _seed(path, _cfg(kernel_variant="bz8y8"), 9e6)  # faster, but locked out
+    dec = ps.resolve(cfg, backend="cpu", ledger_path=path, n_devices=2)
+    assert dec.config.kernel_variant == "bz16y16"
+    assert dec.overrides["kernel_variant"] == "bz16y16"
+
+
+def test_candidates_extend_feasible_variants_only():
+    locked = ps.locked_fields(_cfg())
+    cands = ps.candidates(_cfg(), "cpu", locked, None, 2)
+    vids = {c.kernel_variant for c in cands}
+    # z-only f32 on (96,32,128): bz16y32's y window does not fit
+    assert vids == {"", "bz16y16", "bz8y8"}
+    pinned = ps.candidates(_cfg(), "cpu",
+                           locked | frozenset(["kernel_variant"]),
+                           None, 2)
+    assert {c.kernel_variant for c in pinned} == {""}
+
+
+def test_kernel_variant_is_a_mode_and_adoptable_field():
+    assert "kernel_variant" in ps.MODE_FIELDS
+    assert "kernel_variant" in ps.ADOPTABLE_FIELDS
+
+
+# ------------------------------------------------- bit-exactness
+
+def _assert_variants_bit_exact(cfg, vids):
+    _, step, fields, _ = cli.build(cfg)
+    want = step(fields)
+    for vid in vids:
+        ok, why = autotune.validate_variant(autotune.VARIANTS[vid], cfg)
+        assert ok, f"{vid} infeasible under {cfg.grid}/{cfg.mesh}: {why}"
+        vcfg = dataclasses.replace(cfg, kernel_variant=vid)
+        _, vstep, vfields, _ = cli.build(vcfg)
+        assert getattr(vstep, "_kernel_variant", "") == vid
+        got = vstep(vfields)
+        for a, b in zip(want, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=vid)
+
+
+def test_stream_variants_bit_exact_zonly_f32():
+    _assert_variants_bit_exact(_cfg(), ("bz16y16", "bz8y8"))
+
+
+def test_rdma_variant_bit_exact_zonly_f32():
+    """A deeper ring computes the exact default-ring fields (the full
+    rdma sweep and the other stencil/dtype/mesh combos ride slow)."""
+    _assert_variants_bit_exact(
+        _cfg(grid=(96, 64, 128), exchange="rdma"), ("ring3",))
+
+
+_MATRIX = [
+    # the full stencil x dtype x mesh-family product (ISSUE 16
+    # acceptance); each row lists every feasible swept variant
+    ("heat3d", "float32", (96, 32, 128), (2, 1, 1),
+     ("bz16y16", "bz8y8")),
+    ("heat3d", "bfloat16", (96, 64, 128), (2, 1, 1),
+     ("bz16y16", "bz16y32")),
+    ("heat3d", "float32", (96, 64, 128), (2, 2, 1),
+     ("bz16y16", "bz8y8", "bz16y32")),
+    ("heat3d", "bfloat16", (96, 128, 128), (2, 2, 1),
+     ("bz16y16", "bz16y32")),
+    ("wave3d", "float32", (96, 32, 128), (2, 1, 1),
+     ("bz16y16", "bz8y8")),
+    ("wave3d", "bfloat16", (96, 64, 128), (2, 1, 1),
+     ("bz16y16", "bz16y32")),
+    ("wave3d", "float32", (96, 64, 128), (2, 2, 1),
+     ("bz16y16", "bz8y8", "bz16y32")),
+    ("wave3d", "bfloat16", (96, 128, 128), (2, 2, 1),
+     ("bz16y16", "bz16y32")),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("stencil,dtype,grid,mesh,vids", _MATRIX)
+def test_stream_variants_bit_exact_matrix(stencil, dtype, grid, mesh,
+                                          vids):
+    _assert_variants_bit_exact(
+        _cfg(stencil=stencil, dtype=dtype, grid=grid, mesh=mesh), vids)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("stencil,dtype,mesh,vids", [
+    ("heat3d", "float32", (2, 1, 1), ("ring4", "nc8")),
+    ("heat3d", "bfloat16", (2, 1, 1), ("ring3", "ring4")),
+    ("wave3d", "float32", (2, 2, 1), ("ring3", "ring4")),
+])
+def test_rdma_variants_bit_exact_matrix(stencil, dtype, mesh, vids):
+    _assert_variants_bit_exact(
+        _cfg(stencil=stencil, dtype=dtype, grid=(96, 64, 128),
+             mesh=mesh, exchange="rdma"), vids)
