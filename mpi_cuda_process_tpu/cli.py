@@ -336,6 +336,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "the quickstart face of the scheduler — "
                         "multi-tenant traffic submits through "
                         "serving.ServingEngine in-process")
+    p.add_argument("--serve-router", type=int, default=None,
+                   metavar="PORT",
+                   help="fleet front door (serving/router.py): run this "
+                        "config as a job on a ServingRouter of "
+                        "--router-replicas supervised ServingEngine "
+                        "replicas — admission by AGGREGATE budget, "
+                        "size-class affinity routing (a class's later "
+                        "jobs hit its warm replica: zero backend "
+                        "compiles), zero-lost-jobs rebalance + "
+                        "supervised restart on replica death — with "
+                        "the aggregate fleet console (/status.json "
+                        "hosts table: one row per replica) on PORT "
+                        "(0 = ephemeral)")
+    p.add_argument("--router-replicas", type=int, default=3,
+                   metavar="N",
+                   help="engine replica count behind --serve-router "
+                        "(each a full scheduler with its own budget "
+                        "slice and telemetry log)")
+    p.add_argument("--shrink-after", type=int, default=64,
+                   metavar="K",
+                   help="serving ladder shrink policy: a resident size "
+                        "class that spends K consecutive scheduler "
+                        "rounds at occupancy <= the previous ladder "
+                        "rung with nobody waiting live-repacks its "
+                        "members down that rung (bit-exact, no "
+                        "checkpoint round-trip, never a host gather) "
+                        "and admission re-prices the freed budget; "
+                        "0 disables shrinking")
     p.add_argument("--mem-check", default="error",
                    choices=["error", "warn", "off"],
                    help="per-device HBM budget guard (TPU runs): estimate "
@@ -418,6 +446,8 @@ def config_from_args(argv=None) -> RunConfig:
         serve_port=a.serve_port,
         compile_cache=a.compile_cache,
         serve_engine=a.serve_engine,
+        serve_router=a.serve_router, router_replicas=a.router_replicas,
+        shrink_after=a.shrink_after,
         params=parse_params(a.param),
     )
 
@@ -1623,6 +1653,10 @@ def main(argv=None) -> int:
         from .resilience import supervisor as supervisor_lib
 
         return supervisor_lib.run_supervised(cfg)
+    if cfg.serve_router is not None:
+        from . import serving
+
+        return serving.serve_router_main(cfg)
     if cfg.serve_engine is not None:
         from . import serving
 

@@ -140,6 +140,21 @@ class RunConfig:
     # only, like serve_port: a scheduler-launched child must run the
     # one ordinary CLI path, never nest another scheduler.
     serve_engine: Optional[int] = None
+    # fleet front door (serving/router.py): --serve-router PORT runs
+    # this config as a job on a ServingRouter of --router-replicas
+    # supervised engine replicas (aggregate-budget admission, size-
+    # class affinity, zero-lost-jobs rebalance on replica death) with
+    # the PR-11 aggregate fleet console on PORT.  Launcher-only, like
+    # serve_engine.
+    serve_router: Optional[int] = None
+    router_replicas: int = 3
+    # ladder shrink policy (serving/scheduler.py): after this many
+    # consecutive boundary rounds at occupancy <= the previous ladder
+    # rung with nobody waiting, a resident class live-repacks its
+    # members down a rung and the freed budget is re-priced by
+    # admission.  0 disables.  Lifecycle: migration is bit-exact by
+    # the reshard contract, so it never changes a computed value.
+    shrink_after: int = 64
     params: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:
@@ -162,7 +177,8 @@ class RunConfig:
 # reason: the parent's console serves the child's log, and a child that
 # re-served would race the parent for the port.
 _ARGV_SKIP = frozenset({"supervise", "max_restarts", "restart_backoff",
-                        "supervise_stall_s", "serve_port", "serve_engine"})
+                        "supervise_stall_s", "serve_port", "serve_engine",
+                        "serve_router", "router_replicas", "shrink_after"})
 
 
 # --------------------------------------------------------------------------
@@ -185,7 +201,8 @@ LIFECYCLE_FIELDS = frozenset({
     "dump_every", "dump_dir",
     "telemetry", "mem_check", "supervise", "max_restarts",
     "restart_backoff", "supervise_stall_s", "serve_port",
-    "compile_cache", "serve_engine",
+    "compile_cache", "serve_engine", "serve_router", "router_replicas",
+    "shrink_after",
     # policy_recheck is WHEN mid-flight adoption is reconsidered, not
     # what is computed — migration is bit-exact by the reshard
     # contract, so two submissions differing only here share a

@@ -342,23 +342,29 @@ class SimulationEngine:
             self.metrics.counter(
                 "engine_requests_total", "submitted runs completed").inc()
             if handle.cancelled():
+                # counted, never sampled: a cancelled request's wall
+                # time measures the CALLER (e.g. a router rebalancing
+                # off a dead replica), not the engine — folding it into
+                # the latency/ttfc histograms would skew every p99
                 self.metrics.counter("engine_requests_cancelled_total",
                                      "submitted runs cancelled").inc()
-            elif handle._error is not None:
-                self.metrics.counter("engine_requests_failed_total",
-                                     "submitted runs that raised").inc()
-            if queue_wait is not None:
+            else:
+                if handle._error is not None:
+                    self.metrics.counter(
+                        "engine_requests_failed_total",
+                        "submitted runs that raised").inc()
+                if queue_wait is not None:
+                    self.metrics.histogram(
+                        "engine_queue_wait_s",
+                        "submit -> run-lock acquired").observe(queue_wait)
+                if ttfc is not None:
+                    self.metrics.histogram(
+                        "engine_time_to_first_chunk_s",
+                        "submit -> first completed chunk (the serving "
+                        "SLO)").observe(ttfc)
                 self.metrics.histogram(
-                    "engine_queue_wait_s",
-                    "submit -> run-lock acquired").observe(queue_wait)
-            if ttfc is not None:
-                self.metrics.histogram(
-                    "engine_time_to_first_chunk_s",
-                    "submit -> first completed chunk (the serving "
-                    "SLO)").observe(ttfc)
-            self.metrics.histogram(
-                "engine_request_latency_s",
-                "submit -> result end-to-end").observe(latency)
+                    "engine_request_latency_s",
+                    "submit -> result end-to-end").observe(latency)
         # the request span tree, appended to the closed log so the
         # per-request timeline lives next to the run's own spans
         tid, rid = handle.trace_id, handle.request_span_id

@@ -29,6 +29,14 @@ validator is the single definition) and the same event vocabulary:
 * ``migrate``    — one live mesh migration (``parallel/reshard.py``):
   src/dst mode fields, the adopting step, and the collective round
   count (never a host gather)
+* ``scheduler``  — one serving-scheduler decision
+  (``serving/scheduler.py``: submit/join/retire/evict/preempt/cancel/
+  reject plus the elastic ladder ops ``grow``/``shrink`` — a shrink is
+  a live member-repack down a rung, with occupancy gauges riding every
+  record)
+* ``router``     — one fleet-router decision (``serving/router.py``:
+  route/rebalance/reject/replica_up/replica_dead, with replica
+  liveness and in-flight gauges riding every record)
 * ``error`` / ``summary`` — how the run ended
 
 Sibling stores complete the layer: ``profile.py`` wraps a

@@ -81,7 +81,15 @@ class HostAggregator:
         host = prov.get("hostname") or "?"
         pidx = prov.get("process_index")
         pidx = pidx if isinstance(pidx, int) else "?"
-        return f"{host}|p{pidx}"
+        key = f"{host}|p{pidx}"
+        # N in-process engine replicas behind one router share a
+        # host|process slot; the manifest's top-level ``replica`` tag
+        # (serving/router.py) splits them into distinct fleet rows —
+        # a restarted replica generation re-binds to the SAME row
+        replica = manifest.get("replica")
+        if isinstance(replica, str) and replica:
+            key += f"|{replica}"
+        return key
 
     def _group(self, key: str,
                manifest: Optional[Dict[str, Any]] = None) \
@@ -98,6 +106,8 @@ class HostAggregator:
                     "process_count": prov.get("process_count"),
                     "backend": prov.get("backend"),
                     "device_count": prov.get("device_count"),
+                    "replica": manifest.get("replica")
+                    if isinstance(manifest.get("replica"), str) else None,
                 }
             return rm
 
@@ -148,6 +158,13 @@ class HostAggregator:
         for opt in ("trace_id", "time_to_first_chunk_s"):
             if st.get(opt) is not None:
                 row[opt] = st[opt]
+        if meta.get("replica"):
+            row["replica"] = meta["replica"]
+        # the per-replica serving view the obs_top fleet panel renders:
+        # occupancy gauges + the folded size-class table
+        for block in ("scheduler", "router"):
+            if st.get(block) is not None:
+                row[block] = st[block]
         return row
 
     def status(self) -> Dict[str, Any]:

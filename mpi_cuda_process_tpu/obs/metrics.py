@@ -359,6 +359,10 @@ class RunMetrics:
         # queue depth, slot occupancy, per-op and per-tenant counters —
         # rendered under status()["scheduler"] and the obs_top panel
         self.scheduler: Optional[Dict[str, Any]] = None
+        # fleet-router aggregate (serving/router.py events): replica
+        # liveness, routing/rebalance counters — status()["router"]
+        # and the obs_top fleet panel
+        self.router: Optional[Dict[str, Any]] = None
         # elastic-engine trail (policy/select.py + parallel/reshard.py):
         # the active auto-policy decision and every live migration, so
         # an operator can see what the engine decided and why
@@ -766,6 +770,18 @@ class RunMetrics:
                 "obs_sched_tenant_ops",
                 "per-tenant scheduler decision counts").set(
                 t[op], tenant=tenant, op=op)
+        sc = rec.get("size_class")
+        if isinstance(sc, str) and sc:
+            # the per-class table the obs_top fleet panel renders: op
+            # counts plus the last-known capacity/occupancy carried by
+            # class_build/grow/shrink events
+            entry = sched.setdefault("size_classes", {}).setdefault(
+                sc, {"ops": {}})
+            entry["ops"][op] = entry["ops"].get(op, 0) + 1
+            for k in ("capacity", "occupied"):
+                v = rec.get(k)
+                if isinstance(v, int):
+                    entry[k] = v
         if op == "reject":
             # structured admission refusal: the reason is the payload
             sched["last_reject"] = {
@@ -774,6 +790,43 @@ class RunMetrics:
         sched["last_event"] = {
             "op": op, "tenant": tenant, "job": rec.get("job"),
             "size_class": rec.get("size_class"), "t": rec.get("t")}
+
+    # gauges a router event may carry; each becomes an obs_router_*
+    # gauge and a key of status()["router"]
+    _ROUTER_GAUGES = (
+        ("replicas_alive", "engine replicas currently routable"),
+        ("replicas_total", "engine replicas configured"),
+        ("jobs_inflight", "router jobs not yet resolved"),
+    )
+
+    def _on_router(self, rec: Dict[str, Any]) -> None:
+        """Fold one fleet-router event (serving/router.py).
+
+        Every event carries an ``op`` (route/reject/rebalance/
+        replica_up/replica_dead/resolve) plus the router's liveness
+        gauges; the last event and last death are kept whole so the
+        fleet panel can say WHICH replica died without reading logs.
+        """
+        op = str(rec.get("op") or "event")
+        rt = self.router
+        if rt is None:
+            rt = self.router = {"counts": {}}
+        rt["counts"][op] = rt["counts"].get(op, 0) + 1
+        self.registry.counter(
+            f"obs_router_{_prom_name(op)}_total",
+            f"router '{op}' decisions").inc()
+        for g, help_text in self._ROUTER_GAUGES:
+            v = rec.get(g)
+            if isinstance(v, (int, float)):
+                rt[g] = v
+                self.registry.gauge(f"obs_router_{g}", help_text).set(v)
+        if op == "replica_dead":
+            rt["last_death"] = {
+                "replica": rec.get("replica"), "t": rec.get("t"),
+                "orphans": rec.get("orphans")}
+        rt["last_event"] = {
+            "op": op, "replica": rec.get("replica"),
+            "job": rec.get("job"), "t": rec.get("t")}
 
     def _on_summary(self, rec: Dict[str, Any]) -> None:
         self.summary = rec
@@ -876,6 +929,8 @@ class RunMetrics:
                 out["cancelled"] = self.cancelled
             if self.scheduler is not None:
                 out["scheduler"] = self.scheduler
+            if self.router is not None:
+                out["router"] = self.router
             if self.policy is not None or self.migrations:
                 pol = dict(self.policy or {})
                 pol.pop("kind", None)

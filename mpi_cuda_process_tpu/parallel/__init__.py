@@ -1,6 +1,7 @@
 from .halo import exchange_and_pad, exchange_pad_axis
 from .mesh import bootstrap_distributed, make_mesh, spatial_axis_names
-from .reshard import plan_reshard, reshard_fields
+from .reshard import (plan_member_repack, plan_reshard, repack_members,
+                      reshard_fields)
 from .stepper import grid_partition_spec, make_sharded_step, shard_fields
 
 __all__ = [
@@ -10,7 +11,9 @@ __all__ = [
     "grid_partition_spec",
     "make_mesh",
     "make_sharded_step",
+    "plan_member_repack",
     "plan_reshard",
+    "repack_members",
     "reshard_fields",
     "shard_fields",
     "spatial_axis_names",

@@ -338,6 +338,181 @@ def make_reshard(plan: ReshardPlan, n_fields: int):
     return fn
 
 
+# ------------------------------------------------- member-axis repack
+#
+# The serving layer's defragmentation primitive (ROADMAP item 2): a
+# resident class re-packs OCCUPIED member slots into a (possibly
+# smaller) member axis mid-flight — tenants move, ballast is dropped,
+# and the capacity ladder shrinks — without a checkpoint round-trip and
+# without a host gather.  Unlike the full relayout above, slot moves
+# are an arbitrary partial injection (not block-regular), so the move
+# multigraph over member-shard groups is padded to Δ-regularity with
+# dummy self-preferring edges before the same ``_perfect_matching``
+# decomposition; dummy receives are masked off so no occupied slot is
+# ever clobbered.  When the member axis is not device-sharded (every
+# serving class today: ``ensemble_mesh`` is per-job and resets to 0)
+# the plan degenerates to pure local indexing — zero collectives —
+# still executed inside ``shard_map`` when a spatial mesh exists so the
+# jaxpr gate (``assert_member_repack_structure``) sees per-device avals.
+
+
+class _MemberRound(_Round):
+    """A matching over member-shard groups; ``real[g]`` masks dummy
+    (padding) receives so they never overwrite occupied slots."""
+
+    __slots__ = ("real",)
+
+    def __init__(self, perm, send, recv, real):
+        super().__init__(perm, send, recv)
+        self.real = np.asarray(real, np.int32)
+
+
+class MemberRepackPlan:
+    """Host-side plan moving member slot ``s`` -> ``slot_map[s]`` from a
+    ``(n_src, *grid)`` field into a ``(n_dst, *grid)`` field.  Slots not
+    in ``slot_map`` are dropped; destination slots not hit stay zero
+    (scrubbed ballast — exactly what the scheduler writes on retire).
+    """
+
+    def __init__(self, n_src: int, n_dst: int, slot_map: Dict[int, int],
+                 mesh: Optional[Mesh] = None, grid_ndim: int = 0):
+        self.n_src, self.n_dst = int(n_src), int(n_dst)
+        self.mesh, self.grid_ndim = mesh, int(grid_ndim)
+        items = sorted((int(k), int(v)) for k, v in slot_map.items())
+        if len({v for _, v in items}) != len(items):
+            raise ValueError("slot_map destinations must be unique")
+        for s, d in items:
+            if not 0 <= s < self.n_src:
+                raise ValueError(f"source slot {s} outside [0, {n_src})")
+            if not 0 <= d < self.n_dst:
+                raise ValueError(f"dest slot {d} outside [0, {n_dst})")
+        self.slot_map = dict(items)
+
+        shards = 1
+        if mesh is not None:
+            shards = int(mesh.shape.get(ENSEMBLE_AXIS, 1))
+        self.member_shards = shards
+        if shards > 1 and (self.n_src % shards or self.n_dst % shards):
+            raise ValueError(
+                f"member axis ({self.n_src}->{self.n_dst}) must divide "
+                f"the {shards} member shards on both sides")
+        self.src_local = self.n_src // shards
+        self.dst_local = self.n_dst // shards
+        self.collective = shards > 1
+        self.rounds = self._decompose() if self.collective else []
+        self.n_comm_rounds = sum(1 for r in self.rounds if not r.identity)
+
+    def _decompose(self) -> List[_MemberRound]:
+        E, Ls, Ld = self.member_shards, self.src_local, self.dst_local
+        piles: Dict[Tuple[int, int], List[Tuple[int, int]]] = {}
+        count = np.zeros((E, E), np.int64)
+        for s_old, s_new in self.slot_map.items():
+            gi, sl = divmod(s_old, Ls)
+            gj, rl = divmod(s_new, Ld)
+            piles.setdefault((gi, gj), []).append((sl, rl))
+            count[gi, gj] += 1
+        delta = int(max(count.sum(1).max(initial=0),
+                        count.sum(0).max(initial=0)))
+        if delta == 0:
+            return []
+        # Pad the multigraph to Δ-regularity.  Dummy edges prefer the
+        # diagonal (i == i) so padding lands in identity rounds and
+        # costs no collective; any deficit pair keeps Hall's condition.
+        for i in range(E):
+            while count[i].sum() < delta and delta - count[:, i].sum() > 0:
+                count[i, i] += 1
+        for i in range(E):
+            while count[i].sum() < delta:
+                j = int(np.argmax(delta - count.sum(0)))
+                count[i, j] += 1
+        rounds: List[_MemberRound] = []
+        for _ in range(delta):
+            match = _perfect_matching(count)
+            perm, send, recv, real = [], [0] * E, [0] * E, [0] * E
+            for i in range(E):
+                j = match[i]
+                pile = piles.get((i, j))
+                if pile:
+                    sl, rl = pile.pop()
+                    real[j] = 1
+                else:
+                    sl, rl = 0, 0  # dummy: masked off at the receiver
+                count[i, j] -= 1
+                send[i], recv[j] = sl, rl
+                perm.append((i, j))
+            rounds.append(_MemberRound(perm, send, recv, real))
+        assert not count.any()
+        assert not any(piles.values())
+        return rounds
+
+
+def plan_member_repack(n_src: int, n_dst: int, slot_map: Dict[int, int],
+                       mesh: Optional[Mesh] = None,
+                       grid_ndim: int = 0) -> MemberRepackPlan:
+    """Build the member-axis defrag plan (see :class:`MemberRepackPlan`)."""
+    return MemberRepackPlan(n_src, n_dst, slot_map, mesh, grid_ndim)
+
+
+def make_member_repack(plan: MemberRepackPlan, n_fields: int):
+    """The defrag executor: ``fn(fields) -> fields`` with the member
+    axis re-packed to ``n_dst`` slots.  Pure data movement per surviving
+    slot (bit-exact any dtype); dropped slots vanish, untouched
+    destination slots are zeros.  Trace for the gate; jit to run.
+    """
+    moves = sorted(plan.slot_map.items())
+    src_rows = np.asarray([s for s, _ in moves], np.int32)
+    dst_rows = np.asarray([d for _, d in moves], np.int32)
+
+    def _local(x):
+        buf = jnp.zeros((plan.dst_local,) + x.shape[1:], x.dtype)
+        if len(src_rows):
+            buf = buf.at[dst_rows].set(jnp.take(x, src_rows, axis=0))
+        return buf
+
+    def _rounds(x):
+        gid = lax.axis_index(ENSEMBLE_AXIS)
+        buf = jnp.zeros((plan.dst_local,) + x.shape[1:], x.dtype)
+        for rnd in plan.rounds:
+            out = lax.dynamic_index_in_dim(
+                x, jnp.asarray(rnd.send)[gid], 0, keepdims=False)
+            if not rnd.identity:
+                out = lax.ppermute(out, ENSEMBLE_AXIS, rnd.perm)
+            upd = lax.dynamic_update_index_in_dim(
+                buf, out, jnp.asarray(rnd.recv)[gid], 0)
+            buf = jnp.where(jnp.asarray(rnd.real)[gid].astype(bool),
+                            upd, buf)
+        return buf
+
+    body = _rounds if plan.collective else _local
+    if plan.mesh is None:
+        return lambda fields: tuple(body(f) for f in fields)
+    member = ENSEMBLE_AXIS if plan.collective else None
+    spec = P(member, *tuple(grid_partition_spec(plan.grid_ndim,
+                                                plan.mesh)))
+    sm = shard_map(
+        lambda *fs: tuple(body(f) for f in fs),
+        plan.mesh, in_specs=(spec,) * n_fields,
+        out_specs=(spec,) * n_fields, check_vma=False)
+    return lambda fields: sm(*fields)
+
+
+def repack_members(fields, slot_map: Dict[int, int], n_dst: int,
+                   mesh: Optional[Mesh] = None,
+                   grid_ndim: Optional[int] = None):
+    """Re-pack the leading member axis of ``fields`` to ``n_dst`` slots,
+    moving slot ``s`` -> ``slot_map[s]`` and dropping the rest.  The
+    executor is jitted per (shape, plan) — the serving layer calls this
+    at most once per ladder move, never per chunk.
+    """
+    fields = tuple(fields)
+    if grid_ndim is None:
+        grid_ndim = fields[0].ndim - 1
+    plan = plan_member_repack(fields[0].shape[0], n_dst, slot_map,
+                              mesh, grid_ndim)
+    fn = jax.jit(make_member_repack(plan, len(fields)))
+    return tuple(fn(fields))
+
+
 def reshard_fields(fields, src_mesh: Optional[Mesh],
                    dst_mesh: Optional[Mesh], grid_ndim: int,
                    ensemble: int = 0):

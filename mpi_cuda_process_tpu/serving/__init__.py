@@ -22,15 +22,24 @@ ensemble step:
   fairness with a starvation bound; checkpoint-based preemption;
   per-slot DIVERGED eviction (PR 12's sentinel as the eviction
   signal); per-job telemetry streams riding the obs/ vocabulary.
+  Occupancy changes migrate live members down/up the capacity ladder
+  via ``parallel/reshard.repack_members`` — a defrag, never a
+  checkpoint round-trip.
+* :class:`~.router.ServingRouter` — the fleet front door: N supervised
+  engine replicas behind one submit surface; aggregate-budget
+  admission, size-class affinity routing, zero-lost-jobs rebalance on
+  replica death, one aggregate ``/status.json``.
 """
 
 from .admission import AdmissionController, AdmissionError
+from .router import RouterHandle, ServingRouter, serve_router_main
 from .scheduler import ServeHandle, ServingEngine, serve_engine_main
 from .sizeclass import (CLASS_FIELDS, PER_JOB_SIM_FIELDS, class_config,
                         class_signature)
 
 __all__ = [
     "AdmissionController", "AdmissionError",
+    "RouterHandle", "ServingRouter", "serve_router_main",
     "ServeHandle", "ServingEngine", "serve_engine_main",
     "CLASS_FIELDS", "PER_JOB_SIM_FIELDS",
     "class_config", "class_signature",

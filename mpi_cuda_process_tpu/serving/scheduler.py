@@ -38,7 +38,14 @@ state materializes):
   from its checkpoint, losing no completed chunk;
 * grow: re-build at the next ladder rung (budget-priced first) and
   migrate occupied members — a one-time compile per rung, amortized
-  across every future job of the class.
+  across every future job of the class;
+* shrink: after ``shrink_after_rounds`` consecutive boundaries whose
+  occupancy fits the rung below (and nobody waits), the class releases
+  the rung — occupied members defragment to the lowest slots through
+  the member-axis repack plan (``parallel/reshard.repack_members``):
+  device-to-device moves only, bit-exact per tenant, never a
+  checkpoint round-trip, never a host gather — and the freed capacity
+  re-prices future admissions.  Grow rides the same defrag path.
 
 Chunk sizes are powers of two ≤ min(remaining over occupied slots,
 cadence), so each class needs at most log2(cadence)+1 distinct scan
@@ -63,7 +70,8 @@ from .. import cancellation
 from ..config import RunConfig
 from ..engine import RunHandle
 from .admission import AdmissionController, AdmissionError
-from .sizeclass import class_config, class_signature, ladder_rung, next_rung
+from .sizeclass import (class_config, class_signature, ladder_rung,
+                        next_rung, prev_rung)
 
 __all__ = ["ServeHandle", "ServingEngine", "serve_engine_main"]
 
@@ -168,6 +176,8 @@ class ResidentClass:
         self._step_fn = None
         self.slots: List[Optional[ServeHandle]] = []
         self.rounds = 0          # boundary counter: the starvation clock
+        self.low_rounds = 0      # consecutive low-occupancy boundaries
+        self._mesh = None        # the class's device mesh (None: unsharded)
         self.global_step = 0     # real steps advanced since first build
         self.compiles = 0        # runner builds (distinct scan lengths)
         self.dead: Optional[BaseException] = None
@@ -183,10 +193,15 @@ class ResidentClass:
         solo init before it computes anything a tenant sees)."""
         from .. import cli
 
+        from ..parallel import mesh as mesh_lib
+
         build_cfg = class_config(self.template, capacity)
         with self.engine._step_lock:
             st, step_fn, fields, _ = cli.build(build_cfg)
+        mesh = mesh_lib.make_mesh(build_cfg.mesh) \
+            if cli._uses_mesh(build_cfg) else None
         with self.engine._cv:
+            self._mesh = mesh
             self.st = st
             self._step_fn = step_fn
             self.fields = fields
@@ -209,30 +224,43 @@ class ResidentClass:
             self.compiles += 1
         return r
 
-    def _grow(self, capacity: int) -> None:
-        """Re-build at the next rung and migrate occupied members.
+    def _migrate(self, capacity: int, op: str) -> None:
+        """Re-build at ``capacity`` and DEFRAGMENT: occupied members
+        re-pack to the lowest slots through the member-axis repack plan
+        (``parallel/reshard.repack_members``) — device-to-device moves
+        only, bit-exact per tenant, never a checkpoint round-trip,
+        never a host gather (the jaxpr gate pins this exact path).
 
-        The one scheduled event that DOES compile — once per rung per
-        class, priced by admission before it is attempted."""
+        ``op`` is ``"grow"`` (the one scheduled event that DOES
+        compile — once per rung per class, priced by admission before
+        it is attempted) or ``"shrink"`` (release the rung; freed
+        capacity re-prices future admissions)."""
         from .. import cli
+        from ..parallel import reshard as reshard_lib
 
         build_cfg = class_config(self.template, capacity)
         with self.engine._step_lock:
-            _, step_fn, fields, _ = cli.build(build_cfg)
+            _, step_fn, _, _ = cli.build(build_cfg)
         with self.engine._cv:
-            for i, j in enumerate(self.slots):
-                if j is not None:
-                    fields = tuple(nf.at[i].set(f[i])
-                                   for nf, f in zip(fields, self.fields))
+            occupied = [(i, j) for i, j in enumerate(self.slots)
+                        if j is not None]
+            slot_map = {i: rank for rank, (i, _) in enumerate(occupied)}
+            self.fields = reshard_lib.repack_members(
+                self.fields, slot_map, capacity, mesh=self._mesh,
+                grid_ndim=len(self.template.grid))
             self._step_fn = step_fn
-            self.fields = fields
             self.runners = {}
             self._warm = set()
-            self.slots = self.slots + [None] * (capacity - self.capacity)
+            self.slots = [None] * capacity
+            for rank, (_, j) in enumerate(occupied):
+                self.slots[rank] = j
+                j.slot = rank
             self.capacity = capacity
+            self.low_rounds = 0
             self.cadence_units = max(1, self.engine.cadence // self.unit)
-            self.engine._event("grow", extra={
-                "size_class": self.label, "capacity": capacity})
+            self.engine._event(op, extra={
+                "size_class": self.label, "capacity": capacity,
+                "occupied": len(occupied)})
             self.engine._cv.notify_all()
 
     # -- scheduling (all *_locked under engine._cv) ---------------------
@@ -265,6 +293,26 @@ class ResidentClass:
         except Exception:  # noqa: BLE001 — unpriceable => don't grow
             return None
         return nxt if est["total_bytes"] <= est["hbm_bytes"] else None
+
+    def _shrink_decision_locked(
+            self, active: List[ServeHandle]) -> Optional[int]:
+        """The ladder-shrink policy: ``shrink_after_rounds`` CONSECUTIVE
+        admission rounds whose occupancy fits the rung below — with
+        nobody waiting — release the rung.  Any waiter, any boundary
+        above the low-water mark, or a bottom-rung class resets the
+        clock (a transient dip never thrashes the ladder)."""
+        eng = self.engine
+        if eng.shrink_after_rounds <= 0 or eng._closing:
+            return None
+        low = prev_rung(eng.ladder, self.capacity)
+        if low >= self.capacity or len(active) > low \
+                or self._waiters_locked():
+            self.low_rounds = 0
+            return None
+        self.low_rounds += 1
+        if self.low_rounds < eng.shrink_after_rounds:
+            return None
+        return low
 
     def _maybe_preempt_locked(self, waiters: List[ServeHandle]) -> None:
         """Checkpoint the lowest-priority runner out for a strictly
@@ -404,6 +452,8 @@ class ResidentClass:
         eng = self.engine
         eng._jobs_done += 1
         ttfc = j.timings.get("time_to_first_chunk_s")
+        if ttfc is not None:
+            eng._ttfc.append(ttfc)
         with eng.metrics.lock:
             eng.metrics.counter("serve_jobs_done_total",
                                 "jobs retired complete").inc()
@@ -536,9 +586,12 @@ class ResidentClass:
             except Exception:  # noqa: BLE001
                 pass
             if j.timings.get("time_to_first_chunk_s") is None:
-                ttfc = now - j.submitted_at
-                j.timings["time_to_first_chunk_s"] = round(ttfc, 6)
-                eng._ttfc.append(ttfc)
+                # recorded here, but folded into the engine's p50/p99
+                # list only at retire — a job later cancelled or
+                # evicted (e.g. a router rebalance) must not skew the
+                # serving SLO percentiles
+                j.timings["time_to_first_chunk_s"] = \
+                    round(now - j.submitted_at, 6)
         # fault point (resilience/faults.py numerics site): poison ONE
         # member slot, exactly like a real mid-run bit flip — the
         # sweep below must catch it and evict only that tenant
@@ -597,23 +650,29 @@ class ResidentClass:
                 if self._waiters_locked() and not any(
                         s is None for s in self.slots):
                     grow_to = self._can_grow_locked()
-                if not active and grow_to is None:
+                shrink_to = None
+                if grow_to is None:
+                    shrink_to = self._shrink_decision_locked(active)
+                if not active and grow_to is None and shrink_to is None:
                     if eng._closing and not self._waiters_locked():
                         return
                     eng._cv.wait(0.25)
                     continue
-                if grow_to is None:
+                if grow_to is None and shrink_to is None:
                     chunk_units = self._pick_chunk_locked(active)
                     for j in active:
                         try:
                             j.session.recorder.begin_chunk()
                         except Exception:  # noqa: BLE001
                             pass
-            if grow_to is not None:
+            if grow_to is not None or shrink_to is not None:
                 try:
-                    self._grow(grow_to)
+                    self._migrate(grow_to or shrink_to,
+                                  "grow" if grow_to is not None
+                                  else "shrink")
                 except BaseException:  # noqa: BLE001 — rung stays; jobs
-                    pass               # keep running at current capacity
+                    with eng._cv:      # keep running at current capacity
+                        self.low_rounds = 0
                 continue
             try:
                 warm = chunk_units in self._warm
@@ -634,6 +693,35 @@ class ResidentClass:
                 eng._cv.notify_all()
 
 
+class _NullRecorder:
+    def begin_chunk(self) -> None:
+        pass
+
+    def record_chunk(self, *a, **k) -> None:
+        pass
+
+
+class _NullSession:
+    """Per-job telemetry disabled (``per_job_telemetry=False``): the
+    scheduler's own event stream still tells the whole story; at fleet
+    load-test scale, 10k per-job JSONL files would only measure the
+    filesystem."""
+
+    recorder = _NullRecorder()
+
+    def event(self, *a, **k) -> None:
+        pass
+
+    def finish(self, *a, **k) -> None:
+        pass
+
+    def error(self, *a, **k) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
 class ServingEngine:
     """The serving front-end: ``submit(cfg, tenant=, priority=)``.
 
@@ -652,7 +740,10 @@ class ServingEngine:
                  ladder: Tuple[int, ...] = (1, 2, 4, 8),
                  cadence: int = 32, starvation_rounds: int = 4,
                  compile_cache: Optional[str] = None,
-                 hbm_bytes: Optional[int] = None):
+                 hbm_bytes: Optional[int] = None,
+                 shrink_after_rounds: int = 64,
+                 name: Optional[str] = None,
+                 per_job_telemetry: bool = True):
         from .. import obs
         from ..obs import trace as trace_lib
         from ..obs.metrics import MetricsRegistry
@@ -664,6 +755,9 @@ class ServingEngine:
         self.ladder = ladder
         self.cadence = int(cadence)
         self.starvation_rounds = int(starvation_rounds)
+        self.shrink_after_rounds = int(shrink_after_rounds)
+        self.name = name
+        self.per_job_telemetry = bool(per_job_telemetry)
         self.admission = AdmissionController(hbm_bytes=hbm_bytes)
         self.compile_cache = compile_cache
         if compile_cache:
@@ -698,12 +792,17 @@ class ServingEngine:
             self.telemetry_dir,
             f"serving-{os.getpid()}-{int(time.time() * 1e3)}-"
             f"{next(self._ids)}.jsonl")
+        # ``replica`` rides the manifest TOP level (schema-tolerant
+        # extra key) so obs/aggregate.HostAggregator can split N
+        # in-process replicas of one host|process into distinct rows
+        extra = {"replica": name} if name else {}
         self._session = obs.open_session(
             self.telemetry_path, tool="serving",
             run={"ladder": list(self.ladder), "cadence": self.cadence,
                  "starvation_rounds": self.starvation_rounds,
+                 "shrink_after_rounds": self.shrink_after_rounds,
                  "compile_cache": compile_cache},
-            with_heartbeat=False)
+            with_heartbeat=False, **extra)
 
     # -- telemetry ------------------------------------------------------
 
@@ -820,14 +919,17 @@ class ServingEngine:
             j = ServeHandle(f"job-{os.getpid()}-{seq}", cfg, path,
                             tenant, priority, sig, seq, self)
             j.trace_id = spans_lib.new_id()
-            j.session = obs.open_session(
-                path, tool="serving", run=_dc.asdict(cfg),
-                step_unit=j.unit, with_heartbeat=False,
-                serving={"job": j.id, "tenant": tenant,
-                         "priority": j.priority,
-                         "size_class": j.class_label,
-                         "priced_bytes": est["total_bytes"],
-                         "hbm_bytes": est["hbm_bytes"]})
+            if self.per_job_telemetry:
+                j.session = obs.open_session(
+                    path, tool="serving", run=_dc.asdict(cfg),
+                    step_unit=j.unit, with_heartbeat=False,
+                    serving={"job": j.id, "tenant": tenant,
+                             "priority": j.priority,
+                             "size_class": j.class_label,
+                             "priced_bytes": est["total_bytes"],
+                             "hbm_bytes": est["hbm_bytes"]})
+            else:
+                j.session = _NullSession()
             if decision is not None:
                 # the decision trail rides the job's own manifest log,
                 # exactly like the CLI path (perf_gate --policy-check
@@ -873,6 +975,7 @@ class ServingEngine:
                 "rejects": self._rejects,
                 "preemptions": self._ops.get("preempt", 0),
                 "grows": self._ops.get("grow", 0),
+                "shrinks": self._ops.get("shrink", 0),
                 "ttfc_p50_s": round(quantile(ttfc, 0.5), 6)
                 if ttfc else None,
                 "ttfc_p99_s": round(quantile(ttfc, 0.99), 6)
@@ -958,6 +1061,7 @@ def serve_engine_main(cfg: RunConfig) -> int:
     import dataclasses as _dc
 
     eng = ServingEngine(compile_cache=cfg.compile_cache,
+                        shrink_after_rounds=cfg.shrink_after,
                         telemetry_dir=(os.path.dirname(cfg.telemetry)
                                        if cfg.telemetry else None))
     srv = eng.serve(cfg.serve_engine)

@@ -95,3 +95,12 @@ def next_rung(ladder: Tuple[int, ...], capacity: int) -> int:
         if c > capacity:
             return c
     return capacity
+
+
+def prev_rung(ladder: Tuple[int, ...], capacity: int) -> int:
+    """The rung below ``capacity``, or ``capacity`` at the bottom."""
+    out = capacity
+    for c in ladder:
+        if c < capacity:
+            out = c
+    return out

@@ -463,6 +463,61 @@ def assert_reshard_structure(closed, plan, n_fields: int):
             "max_local_aval": max_local, "global_size": global_size}
 
 
+def assert_member_repack_structure(closed, plan, n_fields: int,
+                                   grid_shape: Tuple[int, ...] = ()):
+    """The serving defrag gate (``parallel/reshard.py`` member repack):
+    re-packing occupied member slots moves state device-to-device ONLY.
+
+    Same discipline as :func:`assert_reshard_structure`, adapted to the
+    member axis:
+
+    1. **Zero ``all_gather``** — defrag never replicates the member
+       axis (or the grid).
+    2. **Exact ppermute count**: ``plan.n_comm_rounds`` collective
+       rounds per field.  A plan whose member axis is not device-sharded
+       schedules ZERO — the local-indexing degradation is pinned too.
+    3. **No full-member-axis intermediate**: when the plan runs under a
+       multi-device mesh, every ``shard_map`` body aval is strictly
+       smaller than the larger of the two global arrays — no device
+       materializes a full (members x grid) state.
+    """
+    n_ag = count_primitive(closed, "all_gather")
+    assert n_ag == 0, (
+        f"member-repack jaxpr contains {n_ag} all_gather eqn(s) — "
+        "defrag must never replicate state")
+    n_pp = count_primitive(closed, "ppermute")
+    expected = plan.n_comm_rounds * n_fields
+    assert n_pp == expected, (
+        f"member-repack jaxpr contains {n_pp} ppermute eqn(s), the "
+        f"plan schedules {expected} ({plan.n_comm_rounds} non-identity "
+        f"round(s) x {n_fields} field(s))")
+    cells = 1
+    for s in grid_shape:
+        cells *= int(s)
+    max_global = max(plan.n_src, plan.n_dst) * cells
+    max_local = 0
+    if plan.mesh is not None and plan.mesh.devices.size > 1:
+        for body in _shard_map_body_jaxprs(closed):
+            for jx in iter_jaxprs(body):
+                for eqn in jx.eqns:
+                    for v in list(eqn.invars) + list(eqn.outvars):
+                        aval = getattr(v, "aval", None)
+                        if aval is None or not hasattr(aval, "shape"):
+                            continue
+                        sz = 1
+                        for d in aval.shape:
+                            sz *= int(d)
+                        max_local = max(max_local, sz)
+                        assert sz < max_global, (
+                            f"member-repack shard_map body holds an "
+                            f"aval of {tuple(aval.shape)} ({sz} elems) "
+                            f">= the global array ({max_global} elems)")
+        assert max_local > 0, \
+            "member-repack jaxpr has no shard_map body at all"
+    return {"n_ppermute": n_pp, "n_all_gather": n_ag,
+            "max_local_aval": max_local, "global_size": max_global}
+
+
 def check_pipeline_structure(
     stencil_name: str = "heat3d",
     grid: Tuple[int, int, int] = (32, 16, 128),

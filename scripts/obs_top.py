@@ -303,7 +303,7 @@ def _scheduler_lines(status) -> list:
             bits.append(f"{k}={sched[k]}")
     counts = sched.get("counts") or {}
     for op in ("submit", "retire", "reject", "evict", "preempt",
-               "cancel", "grow"):
+               "cancel", "grow", "shrink"):
         if counts.get(op):
             bits.append(f"{op}={counts[op]}")
     lines = ["sched   " + "  ".join(bits)]
@@ -330,6 +330,61 @@ def _scheduler_lines(status) -> list:
         lines.append(_table(rows, ["tenant", "submit", "join", "retire",
                                    "evict", "preempt", "cancel",
                                    "reject"]))
+    return lines
+
+
+def _fleet_lines(status) -> list:
+    """Fleet panel (serving/router.py behind the obs/aggregate.py
+    roll-up): router decision counters + one row per engine replica —
+    verdict, queue depth, slot occupancy, grow/shrink counts, and the
+    per-class capacity table."""
+    replicas = [r for r in (status.get("hosts") or ())
+                if r.get("replica")]
+    router = status.get("router")
+    if not router and not replicas:
+        return []
+    lines = []
+    if router:
+        counts = router.get("counts") or {}
+        bits = [f"replicas={router.get('replicas_alive', '?')}/"
+                f"{router.get('replicas_total', '?')}",
+                f"inflight={router.get('jobs_inflight', 0)}"]
+        for op in ("route", "rebalance", "reject", "replica_dead",
+                   "give_up"):
+            if counts.get(op):
+                bits.append(f"{op}={counts[op]}")
+        lines.append("router  " + "  ".join(bits))
+        death = router.get("last_death")
+        if death:
+            lines.append(f"        last death: "
+                         f"{death.get('replica') or '?'} "
+                         f"orphans={death.get('orphans', 0)} "
+                         f"({_age(death.get('t'))})")
+    if replicas:
+        trows = []
+        for r in sorted(replicas, key=lambda r: str(r.get("replica"))):
+            sched = r.get("scheduler") or {}
+            counts = sched.get("counts") or {}
+            classes = sched.get("size_classes") or {}
+            cls_bits = []
+            for sc in sorted(classes):
+                c = classes[sc]
+                tag = str(sc)[:14]
+                if c.get("capacity") is not None:
+                    tag += (f" {c.get('occupied', '?')}"
+                            f"/{c['capacity']}")
+                cls_bits.append(tag)
+            trows.append([
+                r.get("replica"),
+                r.get("verdict") or "-",
+                sched.get("queue_depth", "-"),
+                f"{sched.get('slots_busy', '-')}"
+                f"/{sched.get('slots_total', '-')}",
+                counts.get("grow", 0), counts.get("shrink", 0),
+                "  ".join(cls_bits) or "-"])
+        lines.append(_table(trows, ["replica", "verdict", "queue",
+                                    "slots", "grow", "shrink",
+                                    "classes occ/cap"]))
     return lines
 
 
@@ -404,6 +459,7 @@ def run_frame(status, ledger_path) -> str:
     lines += _health_lines(status)
     lines += _sim_health_lines(status)
     lines += _scheduler_lines(status)
+    lines += _fleet_lines(status)
     lines += _policy_lines(status)
     lines += _hosts_lines(status)
     lines += _campaign_lines(status, ledger_path)

@@ -528,6 +528,75 @@ fi
 timeout -k 10 120 python scripts/perf_gate.py /tmp/_t1_tune/run.jsonl \
   --policy-check --dry --ledger /tmp/_t1_tune/ledger.jsonl \
   > /dev/null || rc=1
+# Fleet-router smoke (round 21, ISSUE 17): three in-process engine
+# replicas behind one ServingRouter — mixed size classes warm two
+# replicas, a replica is killed mid-stream under a long-running job,
+# the job rebalances to a survivor bit-exact vs its solo replay, the
+# supervised restart brings the replica back, and the aggregate
+# /status.json (schema-validated manifests, one fleet row per replica)
+# renders through the obs_top fleet panel with a healthy exit code.
+rm -rf /tmp/_t1_router
+timeout -k 10 300 python -c "
+import json, sys, time, urllib.request
+import numpy as np
+from cpuforce import force_cpu; force_cpu(8)
+from mpi_cuda_process_tpu import cli
+from mpi_cuda_process_tpu.config import RunConfig
+from mpi_cuda_process_tpu.obs import trace as trace_lib
+from mpi_cuda_process_tpu.serving import ServingRouter
+r = ServingRouter(replicas=3, ladder=(1, 2), cadence=8,
+                  restart_backoff=0.05,
+                  telemetry_dir='/tmp/_t1_router')
+url = r.serve(0).url
+warm = [r.submit(RunConfig(stencil='heat2d', grid=(16, 16 + 8 * (i % 2)),
+                           iters=16, seed=i)) for i in range(4)]
+for h in warm: h.result(timeout=240)
+victim_cfg = RunConfig(stencil='heat2d', grid=(16, 16), iters=60000,
+                       seed=9)
+victim = r.submit(victim_cfg)
+target = victim.replica
+while not victim.done() and \\
+        victim._inner.timings.get('time_to_first_chunk_s') is None:
+    time.sleep(0.01)
+assert not victim.done(), 'victim finished before the kill'
+assert r.kill_replica(target)
+fields, _ = victim.result(timeout=600)
+assert victim.resubmits >= 1 and victim.replica != target
+want, _ = cli.run(victim_cfg)
+assert all(np.array_equal(np.asarray(a), np.asarray(b))
+           for a, b in zip(fields, want)), 'rebalanced rerun not bit-exact'
+deadline = time.time() + 20
+while time.time() < deadline and not r.replicas()[target]['alive']:
+    time.sleep(0.05)
+assert r.replicas()[target]['alive'], 'supervised restart never landed'
+after = r.submit(RunConfig(stencil='heat2d', grid=(16, 16), iters=16,
+                           seed=10))
+after.result(timeout=240)
+for rep in r.replicas().values():
+    m = json.loads(open(rep['telemetry']).readline())
+    trace_lib.validate_manifest(m)
+    assert m['replica'] in ('r0', 'r1', 'r2'), m
+time.sleep(0.8)
+stat = json.load(urllib.request.urlopen(url + '/status.json', timeout=5))
+rows = [row for row in stat.get('hosts', []) if row.get('replica')]
+assert len(rows) >= 3, [row.get('key') for row in rows]
+assert stat.get('router', {}).get('counts', {}).get('replica_dead') == 1
+# the live fleet page renders through the obs_top fleet panel with a
+# healthy exit code AFTER the recovery
+sys.path.insert(0, 'scripts')
+import obs_top
+body, status = obs_top.frame(url, None)
+assert obs_top.health_rc(status) == 0, 'fleet unhealthy after recovery'
+assert 'router' in body and 'replica' in body, body
+stats = r.close()
+assert stats['lost_jobs'] == 0 and stats['jobs_done'] == 6, stats
+assert stats['rebalanced'] >= 1 and stats['restarts'] == 1, stats
+assert stats['ttfc_p50_s'] is not None
+print('router smoke ok: kill->rebalance->restart, %d done, 0 lost, '
+      '%d fleet rows' % (stats['jobs_done'], len(rows)))
+" || rc=1
+timeout -k 10 120 python scripts/obs_top.py /tmp/_t1_router/router-*.jsonl \
+  --once > /dev/null || rc=1
 # The committed campaign ledger must render in both one-command
 # summary surfaces: obs_report --ledger (best_known + quarantine
 # table) and the terminal monitor's ledger mode.

@@ -584,11 +584,11 @@ def test_engine_submit_handle_streams_member_telemetry(tmp_path):
     from mpi_cuda_process_tpu.engine import SimulationEngine
 
     eng = SimulationEngine(telemetry_dir=str(tmp_path))
-    h = eng.submit(RunConfig(stencil="heat3d", grid=(32, 16, 128),
+    h = eng.submit(RunConfig(stencil="heat3d", grid=(16, 16, 64),
                              iters=8, ensemble=2, mesh=(2, 1, 1),
                              log_every=2))
     fields, mcells = h.result(timeout=300)
-    assert np.asarray(fields[0]).shape == (2, 32, 16, 128)
+    assert np.asarray(fields[0]).shape == (2, 16, 16, 64)
     status = h.status()
     assert status["verdict"] == "DONE"
     assert status["request"]["phase"] == "done"
@@ -601,7 +601,7 @@ def test_engine_submit_handle_streams_member_telemetry(tmp_path):
     later = h.events(after=evs[0]["_seq"])
     assert later[0]["_seq"] == evs[1]["_seq"]
     # same simulation, different lifecycle -> same signature
-    h2 = eng.submit(RunConfig(stencil="heat3d", grid=(32, 16, 128),
+    h2 = eng.submit(RunConfig(stencil="heat3d", grid=(16, 16, 64),
                               iters=8, ensemble=2, mesh=(2, 1, 1)))
     h2.result(timeout=300)
     assert h2.sim_signature == h.sim_signature

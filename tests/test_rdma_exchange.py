@@ -101,15 +101,21 @@ def _assert_bitexact(got, ref, ctx):
 # survive two exchanges).  Heavier redundant combos ride the slow tier.
 @pytest.mark.parametrize("name,grid,mesh_shape,kw", [
     ("heat3d", (48, 32, 128), (2, 1, 1), {}),
-    ("heat3d", (48, 32, 128), (2, 2, 1), {}),
-    ("wave3d", (48, 32, 128), (2, 2, 1), {}),
+    # heat3d 2-axis f32 rides slow: the 2-axis ring geometry stays in the
+    # default tier via the bf16 leg below (which alone also pins sublane
+    # alignment)
+    pytest.param("heat3d", (48, 32, 128), (2, 2, 1), {},
+                 marks=pytest.mark.slow),
+    # wave3d (multi-field carry) default pin is the cheaper z-only mesh;
+    # its 2-axis variant rides slow
+    ("wave3d", (48, 32, 128), (2, 1, 1), {}),
     ("heat3d", (24, 32, 128), (1, 2, 1), {}),   # y-only: z bc dummies
     # bf16: the ring chunks are sublane-16 aligned (pick_chunks)
     ("heat3d", (48, 32, 128), (2, 2, 1), {"dtype": jnp.bfloat16}),
     # red-black parity across both shard origins through the rdma ring
     pytest.param("sor3d", (96, 32, 128), (2, 2, 1), {},
                  marks=pytest.mark.slow),
-    pytest.param("wave3d", (48, 32, 128), (2, 1, 1), {},
+    pytest.param("wave3d", (48, 32, 128), (2, 2, 1), {},
                  marks=pytest.mark.slow),
     pytest.param("wave3d", (48, 32, 128), (2, 2, 1),
                  {"dtype": jnp.bfloat16}, marks=pytest.mark.slow),
@@ -129,7 +135,10 @@ def test_rdma_matches_ppermute_bitexact(name, grid, mesh_shape, kw):
 # already default above, and the 2-axis overlap+pipeline DEPENDENCE
 # structure is default via test_rdma_pipeline_structure (trace-only).
 @pytest.mark.parametrize("name,mesh_shape,overlap,pipeline", [
-    ("heat3d", (2, 1, 1), True, False),
+    # overlap-without-pipeline rides slow: the overlap+pipeline leg below
+    # exercises the same overlap splice plus the scan carry on top
+    pytest.param("heat3d", (2, 1, 1), True, False,
+                 marks=pytest.mark.slow),
     ("heat3d", (2, 1, 1), True, True),
     pytest.param("heat3d", (2, 2, 1), True, False,
                  marks=pytest.mark.slow),

@@ -64,8 +64,10 @@ def _build_stream(name, grid, mesh_shape, k, overlap=False, tiles=None,
         from mpi_cuda_process_tpu.ops.pallas import streamfused as SF
 
         orig = SF.build_stream_2axis_call
+        # the stepper now always passes tiles= (variant plumbing), so the
+        # forced geometry must REPLACE it, not collide with it
         SF.build_stream_2axis_call = \
-            lambda *a, **k2: orig(*a, tiles=tiles, **k2)
+            lambda *a, **k2: orig(*a, **{**k2, "tiles": tiles})
     try:
         step = make_sharded_fused_step(st, mesh, grid, k, interpret=True,
                                        kind="stream", overlap=overlap)
