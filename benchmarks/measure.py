@@ -128,7 +128,15 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
     rdma specs — ``streamK_shard_tuneN``, ``rdmaK_tuneN`` — runs the
     same step under the autotuner registry's Nth campaign variant for
     the family (policy/autotune.py, Tier-D13): bit-exact schedule
-    sweeps, keyed ``|var:<id>`` in the ledger) | copy
+    sweeps, keyed ``|var:<id>`` in the ledger) | grp2 / grp2het (the
+    COUPLED 2-group split, parallel/groups.py: the device slice
+    partitioned into two contiguous mesh groups coupled at interface
+    ghost bands, each group running the unmodified sharded stepper on
+    its own sub-mesh.  grp2 = same-physics equal split — the A/B
+    against the monolithic sharded row prices exactly the host-
+    orchestrated coupling; grp2het = the MPMD row, the named op
+    2x-refined over the first z quarter plus a base-resolution heat3d
+    far-field, reporting aggregate OWNED-cell Mcells/s) | copy
     (harness-calibration 1R+1W elementwise scan).
     """
     kw = dict(params or {})
@@ -323,6 +331,44 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
         mk = lambda: shard_fields(  # noqa: E731
             init_state(st, grid, kind="auto"), mesh, st.ndim)
         return _time_scan(step, mk, grid, steps, reps, step_unit)
+    elif compute.startswith("grp2"):
+        # COUPLED 2-group split (parallel/groups.py, Tier-D14): two
+        # contiguous device groups, each its own sub-mesh + unmodified
+        # sharded stepper, coupled ONLY at the interface ghost bands.
+        # The built runner must really carry >= 2 groups or the label
+        # refuses: a monolithic fallback must never be priced here.
+        from mpi_cuda_process_tpu.parallel import groups as groups_lib
+
+        n_dev = len(jax.devices())
+        if n_dev < 2:
+            # environmental, not structural: retried on every run
+            raise ValueError(
+                f"grp2 labels need >= 2 devices (have {n_dev})")
+        # y-sharded group meshes (mesh1xH): the ghost band makes each
+        # group's local z extent odd (owned + band), which no z-sharded
+        # sub-mesh divides — sharding y keeps the groups' z rows whole
+        h = n_dev // 2
+        m0, m1 = f":mesh1x{h}", f":mesh1x{n_dev - h}"
+        if compute == "grp2":
+            gspec = (f"{name}@0-{h - 1}{m0},"
+                     f"{name}@{h}-{n_dev - 1}{m1}")
+        elif compute == "grp2het":
+            gspec = (f"{name}:fine@0-{h - 1}:z1/4{m0},"
+                     f"heat3d:coarse@{h}-{n_dev - 1}{m1}")
+        else:
+            raise ValueError(f"unknown grp2 spec {compute!r}")
+        plans = groups_lib.plans_from_config(
+            gspec, grid, default_dtype=dtype or "float32",
+            n_devices=n_dev)
+        runner = groups_lib.CoupledRunner(plans)
+        if getattr(runner, "n_groups", 1) < 2:
+            raise ValueError(
+                "grp2 label built a monolithic runner (n_groups="
+                f"{getattr(runner, 'n_groups', 1)}) — must not price a "
+                "monolithic build under a group label")
+        rec = _time_coupled(runner, steps, reps)
+        rec.setdefault("groups", gspec)
+        return rec
     elif compute.startswith("pipe"):
         # CROSS-PASS pipelined sharded temporal blocking: overlap split
         # + the slab-carry scan (pass i+1's exchange issued from pass
@@ -503,6 +549,54 @@ def _time_scan(step, mk, grid, steps, reps, step_unit, members=0):
         rec["ensemble"] = members
         rec["mcells_per_s_per_member"] = round(mcells / members, 1)
     return rec
+
+
+def _time_coupled(runner, steps, reps):
+    """Timing harness for the coupled group rounds (grp2 labels).
+
+    Same N vs 4N differencing as ``_time_scan``, on ONE warmed runner
+    (each CoupledRunner builds fresh jitted transfer closures, so a
+    fresh runner per rep would re-pay tracing inside the timed region).
+    The fence reads a scalar from EVERY group — the groups dispatch on
+    disjoint devices as independent async streams, and fencing one
+    would leave the others' work unmeasured.  Mcells/s counts OWNED
+    cell updates only, aggregated across groups: band rows are coupling
+    overhead, never throughput, so the hetero row's number is the
+    actual cell-update rate the A/B compares against the monolithic
+    row.
+    """
+    cells = sum(p.owned_cells for p in runner.plans)
+
+    def rounds(n):
+        for f in runner.fields:
+            _fence(f)
+        t0 = time.perf_counter()
+        runner.run(n)
+        for f in runner.fields:
+            _fence(f)
+        return time.perf_counter() - t0
+
+    rounds(1)  # compile + warm every group program and transfer fn
+
+    def best(n):
+        b = math.inf
+        for _ in range(reps):
+            b = min(b, rounds(n))
+        return b
+
+    t_a, t_b = best(steps), best(4 * steps)
+    from bench import NOISE_FLOOR_FRAC  # repo root is on sys.path (top)
+
+    if t_b - t_a <= NOISE_FLOOR_FRAC * t_a:
+        return {"error": f"step time below noise floor: t_a={t_a:.4f}s "
+                         f"t_b={t_b:.4f}s (timing noise; rerun)",
+                "suspect": True}
+    per_round = (t_b - t_a) / (3 * steps)
+    mcells = cells / per_round / 1e6
+    return {"ms_per_step": round(per_round * 1e3, 4),
+            "mcells_per_s": round(mcells, 1),
+            "n_groups": runner.n_groups,
+            "owned_cells_per_round": cells}
 
 
 # (label, stencil, grid, steps, dtype, compute)
@@ -842,6 +936,28 @@ CONFIGS = [
      "float32", "rdma4_tune1"),
     ("wave3d_512_f32_rdma4_tune2", "wave3d", (512, 512, 512), 8,
      "float32", "rdma4_tune2"),
+    # ── Tier D14: COUPLED device groups (round 18, parallel/groups.py)
+    # — *_grp2 rows: the slice partitioned into two contiguous mesh
+    # groups coupled at interface ghost bands, every group running the
+    # UNMODIFIED sharded stepper on its own sub-mesh.  grp2 = same-
+    # physics equal split: the A/B against the monolithic sharded row
+    # (same op, same total cells) prices exactly the host-orchestrated
+    # coupling (interface transfers + per-group dispatch).  grp2het =
+    # the MPMD row: the named op 2x-refined over the first z quarter +
+    # a base-resolution heat3d far-field — aggregate owned-cell
+    # Mcells/s, the cell-update win the groups engine claims.  The
+    # ledger keys these rows |grp:<sig> (obs/ledger.baseline_key), so
+    # a coupled row can never baseline a monolithic one.  Needs >= 2
+    # devices (fast environmental decline + retry elsewhere).  bf16 and
+    # mixed-dtype coupling are pinned bit-exactly on CPU
+    # (tests/test_groups.py); no dedicated chip row — Tier D must stay
+    # strictly under half the campaign (test_measure_campaign.py).
+    ("heat3d_512_f32_grp2", "heat3d", (512, 512, 512), 10, "float32",
+     "grp2"),
+    ("wave3d_512_f32_grp2", "wave3d", (512, 512, 512), 8, "float32",
+     "grp2"),
+    ("wave3d_512_f32_grp2het", "wave3d", (512, 512, 512), 8, "float32",
+     "grp2het"),
 ]
 
 # Tier-D labels: new large Mosaic compiles.  A hang here is plausibly a
@@ -874,7 +990,11 @@ _RISKY = frozenset(
 # labels exist, remote.py's ring kernel is parameterized over slot
 # count / chunk preference and the streaming builders accept variant
 # tiles through the sharded steppers, so older declines retry.
-BUILDER_REV = 11
+# rev 12: the coupled device-group engine (parallel/groups.py) — new
+# *_grp2 labels exist, the streaming builders accept the round-18
+# margin/order sweep constants, and the sharded stepper is now also
+# constructed per-group over device subsets, so older declines retry.
+BUILDER_REV = 12
 
 
 def _skip_cached(cached):

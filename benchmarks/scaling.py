@@ -189,6 +189,55 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
             kernel_kind)
 
 
+def bench_groups(name, n_dev, n_groups, global_shape, steps, reps=3):
+    """Coupled device-group rung (--groups): N same-physics groups.
+
+    The rung's devices split into N contiguous equal groups, each on a
+    y-sharded (1, H) sub-mesh running the unmodified plain sharded
+    stepper, coupled at the interface ghost bands
+    (parallel/groups.py).  Mcells/s counts OWNED cell updates only,
+    aggregated across groups, and the fence reads a scalar from every
+    group — they dispatch on disjoint devices as independent async
+    streams.  Returns None when the geometry cannot host the split
+    (the caller skips the rung, never silently runs monolithic).
+    """
+    import jax.numpy as jnp
+
+    from mpi_cuda_process_tpu.parallel import groups as groups_lib
+
+    if n_dev < n_groups or n_dev % n_groups:
+        return None
+    h = n_dev // n_groups
+    gspec = ",".join(
+        f"{name}@{g * h}-{(g + 1) * h - 1}:mesh1x{h}"
+        for g in range(n_groups))
+    try:
+        plans = groups_lib.plans_from_config(gspec, global_shape,
+                                             n_devices=n_dev)
+        runner = groups_lib.CoupledRunner(plans)
+    except ValueError:
+        # structural decline (z share / y sharding indivisible)
+        return None
+    if getattr(runner, "n_groups", 1) != n_groups:
+        return None  # must not price a different split under this rung
+
+    def rounds(n):
+        for fs in runner.fields:
+            float(jnp.sum(fs[0].astype(jnp.float32)))
+        t0 = time.perf_counter()
+        runner.run(n)
+        for fs in runner.fields:
+            float(jnp.sum(fs[0].astype(jnp.float32)))
+        return time.perf_counter() - t0
+
+    rounds(1)  # compile + warm every group program and transfer fn
+    best = math.inf
+    for _ in range(reps):
+        best = min(best, rounds(steps))
+    cells = sum(pl.owned_cells for pl in runner.plans)
+    return cells * steps / best / 1e6, best / steps, gspec
+
+
 def bench_halo_overhead(st, mesh_shape, global_shape, steps, reps=3):
     """Per-step halo-exchange cost, isolated (SURVEY.md §5.1 attribution).
 
@@ -320,6 +369,22 @@ def main(argv=None) -> int:
                         "ensemble size, so batched rows are never "
                         "confused with single-sim rows (the ledger "
                         "keys them apart)")
+    p.add_argument("--groups", type=int, default=0, metavar="N",
+                   help="coupled device-group ladder arm (round 18, "
+                        "parallel/groups.py): every rung partitions its "
+                        "devices into N contiguous same-physics groups "
+                        "(y-sharded sub-meshes) coupled at interface "
+                        "ghost bands, each group running the UNMODIFIED "
+                        "plain sharded stepper — the A/B against the "
+                        "same ladder without --groups prices exactly the "
+                        "host-orchestrated coupling (interface transfers "
+                        "+ per-group dispatch).  Rungs whose device "
+                        "count cannot host the split (fewer than N, or "
+                        "N does not divide it) are skipped, never "
+                        "silently run monolithic; every emitted row "
+                        "stamps the groups spec, so coupled rows are "
+                        "never confused with monolithic rows (the "
+                        "ledger keys them apart |grp:<sig>)")
     p.add_argument("--telemetry", default=None, metavar="PATH",
                    help="write a JSONL telemetry event log (obs/ "
                         "schema, same manifest as cli --telemetry): "
@@ -348,6 +413,20 @@ def main(argv=None) -> int:
                     "only; drop --fuse-kind or set it to stream")
         # pin the kernel class so every rung prices the same kernel
         a.fuse_kind = "stream"
+    if a.groups:
+        if a.groups < 2:
+            p.error("--groups needs N >= 2 (a 1-group run is monolithic "
+                    "— run the plain ladder instead)")
+        bad = [flag for flag, on in (
+            ("--fuse", a.fuse > 1), ("--overlap", a.overlap),
+            ("--pipeline", a.pipeline), ("--ensemble", a.ensemble > 0),
+            ("--exchange rdma", a.exchange == "rdma"),
+            ("--fuse-kind", a.fuse_kind is not None)) if on]
+        if bad:
+            p.error(f"--groups conflicts with {', '.join(bad)}: coupled "
+                    "rungs run each group's plain sharded stepper, so "
+                    "the A/B against the monolithic ladder prices the "
+                    "coupling and nothing else")
     if a.pipeline:
         if not (a.fuse > 1):
             p.error("--pipeline needs --fuse K (the slab-carry scan "
@@ -366,6 +445,9 @@ def main(argv=None) -> int:
     from mpi_cuda_process_tpu.ops.stencil import make_stencil
 
     st = make_stencil(a.stencil)
+    if a.groups and st.ndim != 3:
+        p.error("--groups partitions the z axis of a 3-d stencil; "
+                f"{a.stencil} is {st.ndim}-d")
     n_devices = len(jax.devices())
 
     session = None
@@ -455,24 +537,42 @@ def _ladder(a, p, jax, st, n_devices, _tel) -> int:
             global_shape = parse_int_tuple(a.grid)
             if any(g % m for g, m in zip(global_shape, mesh_shape)):
                 continue
-        got = bench_config(
-            st, mesh_shape, global_shape, a.steps, a.reps,
-            overlap=a.overlap, fuse=a.fuse, fuse_kind=a.fuse_kind,
-            pipeline=a.pipeline, exchange=a.exchange,
-            ensemble=a.ensemble)
-        if got is None:
-            print(f"[scaling] skip {mesh_shape}: untileable fused "
-                  f"k={a.fuse}"
-                  + (" (or cannot host --pipeline)" if a.pipeline
-                     else "")
-                  + (" (or cannot host --exchange rdma)"
-                     if a.exchange == "rdma" else ""), file=sys.stderr)
-            _tel("skip", mesh=list(mesh_shape), grid=list(global_shape),
-                 fuse=a.fuse, pipeline=a.pipeline, exchange=a.exchange,
-                 reason="untileable or cannot host the requested "
-                        "overlap/pipeline/kind/exchange contract")
-            continue
-        mcells, per_step, kernel_kind = got
+        gspec = None
+        if a.groups:
+            got = bench_groups(a.stencil, n_dev, a.groups, global_shape,
+                               a.steps, a.reps)
+            if got is None:
+                print(f"[scaling] skip {mesh_shape}: {n_dev} device(s) "
+                      f"cannot host {a.groups} coupled groups",
+                      file=sys.stderr)
+                _tel("skip", mesh=list(mesh_shape),
+                     grid=list(global_shape), groups=a.groups,
+                     reason="device count or geometry cannot host the "
+                            "coupled group split")
+                continue
+            mcells, per_step, gspec = got
+            kernel_kind = None
+        else:
+            got = bench_config(
+                st, mesh_shape, global_shape, a.steps, a.reps,
+                overlap=a.overlap, fuse=a.fuse, fuse_kind=a.fuse_kind,
+                pipeline=a.pipeline, exchange=a.exchange,
+                ensemble=a.ensemble)
+            if got is None:
+                print(f"[scaling] skip {mesh_shape}: untileable fused "
+                      f"k={a.fuse}"
+                      + (" (or cannot host --pipeline)" if a.pipeline
+                         else "")
+                      + (" (or cannot host --exchange rdma)"
+                         if a.exchange == "rdma" else ""),
+                      file=sys.stderr)
+                _tel("skip", mesh=list(mesh_shape),
+                     grid=list(global_shape), fuse=a.fuse,
+                     pipeline=a.pipeline, exchange=a.exchange,
+                     reason="untileable or cannot host the requested "
+                            "overlap/pipeline/kind/exchange contract")
+                continue
+            mcells, per_step, kernel_kind = got
         per_dev = mcells / n_dev
         if base is None:
             base = per_dev if a.mode == "weak" else mcells
@@ -488,6 +588,8 @@ def _ladder(a, p, jax, st, n_devices, _tel) -> int:
             "ensemble": a.ensemble,
             "kernel_kind": kernel_kind,
             "mesh_axes": a.mesh_axes,
+            "n_groups": a.groups,
+            "groups": gspec,
             "mesh": list(mesh_shape), "grid": list(global_shape),
             "mcells_per_s": round(mcells, 1),
             "mcells_per_s_per_device": round(per_dev, 1),

@@ -55,6 +55,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="float32|bfloat16|int32|... (default: stencil's own)")
     p.add_argument("--mesh", type=parse_int_tuple, default=(),
                    help="per-grid-axis shard counts, e.g. 2,2 (default: no sharding)")
+    p.add_argument("--groups", default="",
+                   help="MPMD device groups (parallel/groups.py): "
+                        "partition the slice into contiguous sub-meshes "
+                        "along grid axis 0, each running its OWN op / "
+                        "refinement ratio / dtype / mesh, coupled only "
+                        "at interface faces — e.g. "
+                        "\"wave3d:fine@0-3:z1/4,heat3d:coarse@4-7\" runs "
+                        "a 2x-refined wave3d hot region over the first "
+                        "quarter of z on devices 0-3 inside a coarse "
+                        "heat3d far-field on devices 4-7.  Clause "
+                        "grammar: <op>[:fine[R]|:coarse][:<dtype>]@"
+                        "<d0>-<d1>[:z<num>/<den>][:mesh<m0>x<m1>...].  "
+                        "Each group's interior step is the unmodified "
+                        "sharded stepper on its sub-mesh; the ghost-band "
+                        "interface refresh is the only cross-group "
+                        "traffic (jaxprcheck.assert_coupled_structure "
+                        "pins it).  A 2-group same-physics split is "
+                        "bit-exact vs the monolithic run.  Excludes the "
+                        "monolithic mode flags (--mesh/--fuse/--ensemble"
+                        "/--overlap/--pipeline/...): per-group behavior "
+                        "lives in the clauses")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--density", type=float, default=0.15,
                    help="alive probability for random init (reference: 0.15)")
@@ -423,7 +444,8 @@ def config_from_args(argv=None) -> RunConfig:
     a = build_parser().parse_args(argv)
     return RunConfig(
         stencil=a.stencil, grid=a.grid, iters=a.iters, dtype=a.dtype,
-        mesh=a.mesh, seed=a.seed, density=a.density, init=a.init,
+        mesh=a.mesh, groups=a.groups, seed=a.seed, density=a.density,
+        init=a.init,
         periodic=a.periodic, log_every=a.log_every,
         checkpoint_every=a.checkpoint_every, checkpoint_dir=a.checkpoint_dir,
         checkpoint_backend=a.checkpoint_backend,
@@ -525,6 +547,11 @@ def maybe_auto_fuse(cfg: RunConfig) -> RunConfig:
     ``run``'s auto-retry, which re-runs the whole config on the jnp path.
     """
     if cfg.compute != "auto" or cfg.fuse:
+        return cfg
+    if cfg.groups:
+        # a coupled run's per-group steppers are built by the coupled
+        # runner, not build(); the monolithic fuse upgrade has no step
+        # to upgrade
         return cfg
     if cfg.fuse_kind != "auto":
         # a user-forced kind without --fuse must reach build()'s
@@ -1155,10 +1182,26 @@ def _open_telemetry(cfg: RunConfig):
                               or 600)
     except ValueError:
         stall_after_s = 600.0
+    extra = {}
+    if cfg.groups:
+        # the manifest's `groups` block: one resolved entry per group
+        # (op/ratio/dtype/devices/mesh/grid) so a log reader never
+        # re-parses the --groups grammar.  Best-effort: a malformed
+        # spec raises properly in _run_coupled WITH a session open to
+        # record the error, so plan failures stay silent here.
+        try:
+            from .parallel import groups as groups_lib
+
+            extra["groups"] = [
+                p.describe() for p in groups_lib.plans_from_config(
+                    cfg.groups, cfg.grid,
+                    default_dtype=cfg.dtype or None)]
+        except Exception:  # noqa: BLE001 — see above
+            pass
     return obs.open_session(
         cfg.telemetry, tool="cli", run=dataclasses.asdict(cfg),
         step_unit=max(1, cfg.fuse), stall_after_s=stall_after_s,
-        ensemble=cfg.ensemble)
+        ensemble=cfg.ensemble, **extra)
 
 
 def _emit_static_cost(cfg: RunConfig, st, session) -> None:
@@ -1236,7 +1279,225 @@ def _run_once(cfg: RunConfig, decision=None) -> Tuple:
             server.close()
 
 
+# Monolithic mode flags that do not compose with --groups: each group's
+# clause is its own config, so a slice-wide mode flag has no single run
+# to configure.  (name, predicate) — the forced-flag contract: every
+# conflict raises with the reason, never a silent ignore.
+_GROUP_CONFLICTS = (
+    ("--mesh", lambda c: bool(c.mesh)),
+    ("--ensemble/--ensemble-mesh/--ensemble-perturb",
+     lambda c: bool(c.ensemble or c.ensemble_mesh or c.ensemble_perturb)),
+    ("--fuse", lambda c: bool(c.fuse)),
+    ("--fuse-kind", lambda c: c.fuse_kind != "auto"),
+    ("--overlap", lambda c: c.overlap),
+    ("--pipeline", lambda c: c.pipeline),
+    ("--exchange rdma", lambda c: c.exchange == "rdma"),
+    ("--periodic", lambda c: c.periodic),
+    ("--tol", lambda c: c.tol > 0),
+    ("--profile/--profile-dir",
+     lambda c: bool(c.profile or c.profile_dir)),
+    ("--halo-audit", lambda c: bool(c.halo_audit)),
+    ("--debug-checks", lambda c: c.debug_checks),
+    ("--policy-recheck", lambda c: bool(c.policy_recheck)),
+    ("--compute pallas", lambda c: c.compute == "pallas"),
+    ("--kernel-variant", lambda c: bool(c.kernel_variant)),
+    ("--dump-every", lambda c: bool(c.dump_every)),
+)
+
+
+def _check_coupled_mem_budget(cfg: RunConfig, plans) -> None:
+    """Per-group HBM guard for a coupled run (TPU backends)."""
+    if cfg.mem_check == "off" or jax.default_backend() != "tpu":
+        return
+    from .utils import budget
+
+    try:
+        worst, _ = budget.check_coupled_budget(plans)
+    except ValueError:
+        if cfg.mem_check == "error":
+            raise
+        log.warning("HBM budget exceeded (--mem-check warn): proceeding "
+                    "anyway; expect RESOURCE_EXHAUSTED", exc_info=True)
+    else:
+        log.debug("HBM budget (coupled): worst group ~%.2f GiB/device",
+                  worst / 2**30)
+
+
+def _run_coupled(cfg: RunConfig, session, decision=None) -> Tuple:
+    """The ``--groups`` run loop: N device groups, coupled at faces.
+
+    The coupled analogue of the `_run_measured` tail: per-group budget
+    guard, per-group costmodel event, a chunked host round loop with
+    per-group "group_chunk" telemetry, per-group health sentinels (a
+    DIVERGED verdict names the group), and coupled checkpoint/resume
+    (per-group subdirs, one agreed step).
+    """
+    from .parallel import groups as groups_lib
+
+    for flag, fired in _GROUP_CONFLICTS:
+        if fired(cfg):
+            raise ValueError(
+                f"--groups partitions the slice into per-group sub-"
+                f"meshes with their own per-group configs; {flag} "
+                "configures the monolithic run and does not compose "
+                "with --groups (put per-group behavior in the group "
+                "clauses: <op>[:fine[R]|:coarse][:<dtype>]@<d0>-<d1>"
+                "[:z<num>/<den>][:mesh<m0>x<m1>...])")
+    plans = groups_lib.plans_from_config(
+        cfg.groups, cfg.grid, default_dtype=cfg.dtype or None,
+        n_devices=jax.device_count())
+    _check_coupled_mem_budget(cfg, plans)
+    enable_compile_cache(cfg.compile_cache)
+    mesh_lib.bootstrap_distributed()
+    runner = groups_lib.CoupledRunner(
+        plans, seed=cfg.seed, density=cfg.density, init_kind=cfg.init)
+
+    start_round = 0
+    if cfg.resume and cfg.checkpoint_dir and os.path.isdir(
+            os.path.join(cfg.checkpoint_dir, "group0")):
+        start_round = runner.load_checkpoint(cfg.checkpoint_dir)
+        log.info("resumed coupled run from %s at round %d",
+                 cfg.checkpoint_dir, start_round)
+    if session is not None:
+        try:
+            from .obs import costmodel
+
+            session.event("costmodel", **costmodel.coupled_cost(plans))
+        except Exception:  # noqa: BLE001 — telemetry never load-bearing
+            log.debug("coupled cost model failed; trace goes without it",
+                      exc_info=True)
+        if start_round:
+            session.event("resume", resumed_from_step=start_round)
+
+    remaining = cfg.iters - start_round
+    if remaining <= 0:
+        log.info("coupled checkpoint already at round %d >= iters",
+                 start_round)
+        if session is not None:
+            session.finish(steps=0, mcells_per_s=0.0,
+                           note="checkpoint already at/past iters")
+        return runner.assemble(), 0.0
+
+    monitors = None
+    if cfg.health:
+        from .obs import health as health_lib
+
+        # per-group sentinels, trace=None: the coupled loop emits the
+        # "health" events itself so every record carries its group name.
+        # open_system: a coupled group exchanges its invariant quantity
+        # through the interface bands by construction, so the op's
+        # conservation-drift rule is informational here — NaN/Inf and a
+        # non-finite invariant stay hard triggers
+        monitors = [health_lib.HealthMonitor(p.stencil, open_system=True)
+                    for p in plans]
+
+    intervals = [v for v in (cfg.log_every, cfg.checkpoint_every,
+                             cfg.check_finite) if v]
+    interval = math.gcd(*intervals) if len(intervals) > 1 else (
+        intervals[0] if intervals else 0)
+    if cfg.health and not interval and remaining >= 2:
+        interval = max(1, remaining // 8)
+
+    cells_round = runner.cell_updates_per_round()
+    done = 0
+    chunk = 0
+    t0 = time.perf_counter()
+    while done < remaining:
+        n = min(interval or remaining, remaining - done)
+        tc = time.perf_counter()
+        runner.run(n)
+        runner.block_until_ready()
+        dtc = time.perf_counter() - tc
+        done += n
+        step = start_round + done
+        cancellation.check(step)
+        faults.maybe_fire("exchange", step=step)
+        if session is not None:
+            session.recorder.record_chunk(n, dtc)
+            for p in plans:
+                session.event(
+                    "group_chunk", step=step, group=p.name, op=p.spec.op,
+                    ratio=p.ratio,
+                    dtype=str(np.dtype(p.stencil.dtype)),
+                    steps=n, wall_s=round(dtc, 4),
+                    mcells_per_s=round(p.cells * n / dtc / 1e6, 3))
+        poison = faults.injected_numeric_poison(step)
+        if poison is not None:
+            from .obs import health as health_lib
+
+            runner.fields[0] = health_lib.apply_nan_poison(
+                runner.fields[0])
+        if cfg.check_finite and step % cfg.check_finite == 0:
+            for p, fs in zip(plans, runner.fields):
+                for i, f in enumerate(fs):
+                    if not jnp.issubdtype(f.dtype, jnp.inexact):
+                        continue
+                    if not bool(jnp.isfinite(f).all()):
+                        raise RuntimeError(
+                            f"group {p.name} field {i} became non-"
+                            f"finite by step {step} (NaN/Inf blow-up "
+                            "— check stability parameters)")
+        if monitors is not None:
+            from .obs import health as health_lib
+
+            for p, mon, fs in zip(plans, monitors, runner.fields):
+                rec = mon.check(step, fs, chunk=chunk)
+                rec["group"] = p.name
+                if session is not None:
+                    session.event("health", **rec)
+                if rec["verdict"] == health_lib.VERDICT_DIVERGED:
+                    # the group is named FIRST — the eviction verdict
+                    # the engine/supervisor read must say which group's
+                    # physics blew up, not just that something did
+                    raise health_lib.SimulationDiverged(
+                        f"group {p.name} DIVERGED at step {step}: "
+                        f"{rec['reason']}", record=rec)
+        if cfg.log_every and step % cfg.log_every == 0:
+            log.info("round %d  %s", step, "  ".join(
+                f"{p.name}:{p.cells * n / dtc / 1e6:.1f}Mc/s"
+                for p in plans))
+        if cfg.checkpoint_every and cfg.checkpoint_dir and \
+                step % cfg.checkpoint_every == 0:
+            with _session_span(session, "checkpoint", step=step):
+                runner.save_checkpoint(cfg.checkpoint_dir)
+        chunk += 1
+    dt = time.perf_counter() - t0
+
+    if monitors is not None and monitors[0].checks == 0:
+        from .obs import health as health_lib
+
+        for p, mon, fs in zip(plans, monitors, runner.fields):
+            rec = mon.check(cfg.iters, fs)
+            rec["group"] = p.name
+            if session is not None:
+                session.event("health", **rec)
+            if rec["verdict"] == health_lib.VERDICT_DIVERGED:
+                raise health_lib.SimulationDiverged(
+                    f"group {p.name} DIVERGED at step {cfg.iters}: "
+                    f"{rec['reason']}", record=rec)
+
+    mcells = cells_round * remaining / dt / 1e6
+    log.info("%d coupled rounds x %d groups (%d cell-updates/round) in "
+             "%.3fs  (%.1f Mcells/s)", remaining, len(plans),
+             cells_round, dt, mcells)
+    if session is not None:
+        session.finish(steps=remaining, wall_s=round(dt, 4),
+                       mcells_per_s=round(mcells, 3), coupled=True,
+                       n_groups=len(plans),
+                       cell_updates_per_round=cells_round)
+    if cfg.checkpoint_dir and (cfg.checkpoint_every or cfg.resume):
+        with _session_span(session, "checkpoint", step=cfg.iters,
+                           final=True):
+            runner.save_checkpoint(cfg.checkpoint_dir)
+    fields = runner.assemble()
+    if cfg.render:
+        print(render.ascii_render(np.asarray(fields[0])))
+    return fields, mcells
+
+
 def _run_measured(cfg: RunConfig, session, decision=None) -> Tuple:
+    if cfg.groups:
+        return _run_coupled(cfg, session, decision=decision)
     if cfg.debug_checks and cfg.fuse:
         raise ValueError("--debug-checks excludes --fuse (the fused "
                          "kernel replaces the step being instrumented)")

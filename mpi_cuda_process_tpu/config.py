@@ -65,6 +65,12 @@ class RunConfig:
     # rings, ops/pallas/remote.py — streaming kind only, never a silent
     # fallback)
     exchange: str = "ppermute"
+    # MPMD device groups (parallel/groups.py): partition the slice into
+    # contiguous sub-meshes along grid axis 0, each running its own
+    # op/resolution/dtype, coupled ONLY at interface faces — e.g.
+    # "wave3d:fine@0-3:z1/4,heat3d:coarse@4-7".  "" = monolithic SPMD.
+    # SIM field: the group layout picks the compiled programs.
+    groups: str = ""
     # measurement-driven execution policy (policy/select.py): resolve
     # every mode flag NOT explicitly passed (--mesh/--ensemble-mesh/
     # --fuse/--fuse-kind/--overlap/--pipeline/--exchange) from the
@@ -261,6 +267,23 @@ def to_argv(cfg: RunConfig) -> list:
         else:
             out += [flag, str(v)]
     return out
+
+
+def groups_signature(groups: str) -> str:
+    """Short stable signature of a ``--groups`` string.
+
+    Whitespace-normalized, so cosmetically different spellings of the
+    same split share a signature; structurally different splits never
+    do (within the hash).  The ledger's ``|grp:<sig>`` baseline-key
+    tail and the coupled label tag both hang off this — kept here (not
+    in ``parallel/groups.py``) so the pure-python obs/ledger path never
+    imports the jax-heavy builder.
+    """
+    import hashlib
+
+    canon = ",".join(p.strip() for p in (groups or "").split(",")
+                     if p.strip())
+    return hashlib.sha1(canon.encode()).hexdigest()[:10]
 
 
 def parse_int_tuple(s: str) -> Tuple[int, ...]:

@@ -165,13 +165,21 @@ class HealthMonitor:
     informational per-field drift (never a trigger — field means move
     legitimately).  ``raise_on_diverged`` callers use
     :meth:`check_or_raise`.
+
+    ``open_system=True`` demotes rule 3 to informational: a coupled
+    device group (``--groups``) exchanges its invariant quantity
+    through the interface bands by construction, so conservation drift
+    is expected physics there, not divergence — the drift still lands
+    in the invariant block (tagged ``"open_system": true``) for
+    obs_top/report, but only rules 1-2 can flip the verdict.
     """
 
     def __init__(self, stencil: Stencil, trace=None, ensemble: int = 0,
-                 spans=None):
+                 spans=None, open_system: bool = False):
         self.stencil = stencil
         self.trace = trace
         self.spans = spans
+        self.open_system = bool(open_system)
         self.ensemble = int(ensemble)
         self._fn = make_health_fn(stencil, ensemble=ensemble)
         self.baseline: Optional[Dict[str, Any]] = None
@@ -246,12 +254,14 @@ class HealthMonitor:
                 "drift": ([_round(d, 6) for d in drifts] if ens
                           else _round(drifts[0], 6)),
             }
+            if self.open_system:
+                inv_block["open_system"] = True
             bad = [j for j, v in enumerate(values) if not math.isfinite(v)]
             if bad:
                 reasons.append(
                     f"invariant '{inv.name}' non-finite"
                     + (f" for member(s) {bad}" if ens else ""))
-            elif inv.rtol is not None:
+            elif inv.rtol is not None and not self.open_system:
                 over = [j for j, d in enumerate(drifts) if d > inv.rtol]
                 if over:
                     reasons.append(

@@ -117,6 +117,14 @@ def baseline_key(row: Dict[str, Any]) -> str:
     var = flags.get("kernel_variant")
     if var:
         tail += f"|var:{var}"
+    grp = flags.get("groups_sig")
+    if grp:
+        # the GROUP SIGNATURE (round 18, parallel/groups.py): a coupled
+        # --groups row times a heterogeneous multi-program round, so it
+        # must never baseline a monolithic row (or vice versa, or a row
+        # with a DIFFERENT split) — across group signatures the gate
+        # reports NO_BASELINE, not REGRESSED
+        tail += f"|grp:{grp}"
     return f"{k['label']}|{k.get('backend')}{tail}"
 
 
@@ -316,6 +324,13 @@ def _flags(run: Dict[str, Any]) -> Dict[str, Any]:
             out["ensemble_mesh"] = run["ensemble_mesh"]
     if run.get("kernel_variant"):
         out["kernel_variant"] = run["kernel_variant"]
+    if run.get("groups"):
+        # a short stable signature, not the raw spec string: the flag
+        # set rides every row and key, and the signature is what the
+        # |grp: baseline-key tail needs (config.groups_signature)
+        from ..config import groups_signature
+
+        out["groups_sig"] = groups_signature(run["groups"])
     return out
 
 
@@ -342,6 +357,9 @@ def _cli_label(run: Dict[str, Any]) -> str:
             parts.append(f"ensmesh{run['ensemble_mesh']}")
     if run.get("kernel_variant"):
         parts.append(f"var{run['kernel_variant']}")
+    if run.get("groups"):
+        n = len([c for c in str(run["groups"]).split(",") if c.strip()])
+        parts.append(f"grp{n}")
     return "cli_" + "_".join(p for p in parts if p)
 
 

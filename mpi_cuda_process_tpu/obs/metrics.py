@@ -374,6 +374,11 @@ class RunMetrics:
         self.resumed_from_step: Optional[int] = None
         self.labels: "collections.OrderedDict[str, Dict[str, Any]]" = \
             collections.OrderedDict()
+        # coupled-run group table (round 18, parallel/groups.py): one
+        # row per device group, seeded from the manifest's ``groups``
+        # block and refreshed by group_chunk / per-group health events
+        self.groups: "collections.OrderedDict[str, Dict[str, Any]]" = \
+            collections.OrderedDict()
         self.errors: Deque[Dict[str, Any]] = \
             collections.deque(maxlen=max_errors)
         self._cells: Optional[int] = None
@@ -441,6 +446,15 @@ class RunMetrics:
             self.registry.gauge(
                 "obs_ensemble_size",
                 "simultaneous simulations in the batched step").set(ens)
+        for g in rec.get("groups") or ():
+            # seed the group table from the manifest's plan describe():
+            # the panel shows every group's identity before the first
+            # group_chunk lands
+            if isinstance(g, dict) and isinstance(g.get("group"), str):
+                self.groups.setdefault(g["group"], {}).update(
+                    {k: g.get(k) for k in ("op", "ratio", "dtype",
+                                           "devices", "grid")
+                     if g.get(k) is not None})
         self.registry.info(
             "obs_run_info", "identity of the (primary) run").set(
             tool=rec.get("tool"), stencil=run.get("stencil"),
@@ -516,6 +530,31 @@ class RunMetrics:
                 "max device memory peak over all chunks").set_max(peak)
         self._update_roofline_gap()
 
+    def _on_group_chunk(self, rec: Dict[str, Any]) -> None:
+        """Fold one per-group chunk of a coupled run (cli._run_coupled):
+        each device group's own op/resolution/dtype identity and its
+        throughput, keyed by the group name (``g0:wave3d``)."""
+        name = rec.get("group")
+        if not isinstance(name, str) or not name:
+            return
+        self.registry.counter("obs_group_chunks_total",
+                              "coupled-run group chunks ingested").inc()
+        entry = self.groups.setdefault(name, {})
+        for k in ("op", "ratio", "dtype"):
+            if rec.get(k) is not None:
+                entry[k] = rec[k]
+        entry["last_step"] = rec.get("step")
+        entry["steps_total"] = (entry.get("steps_total") or 0) + \
+            int(rec.get("steps") or 0)
+        mc = rec.get("mcells_per_s")
+        if isinstance(mc, (int, float)):
+            entry["mcells_per_s"] = mc
+            self.registry.gauge_family(
+                "obs_group_mcells_per_s",
+                "latest per-group throughput of the coupled run, "
+                "Mcells/s").set(mc, group=name,
+                                op=str(rec.get("op") or ""))
+
     def _on_costmodel(self, rec: Dict[str, Any]) -> None:
         self.costmodel = rec
         roof = rec.get("roofline") or {}
@@ -568,6 +607,12 @@ class RunMetrics:
         """Fold one numerics-sentinel check (obs/health.py)."""
         self.health = rec
         verdict = rec.get("verdict")
+        group = rec.get("group")
+        if isinstance(group, str) and group:
+            # coupled runs health-check per group: the named group's
+            # row carries its own verdict (a DIVERGED group still
+            # dominates the run verdict through self.health below)
+            self.groups.setdefault(group, {})["verdict"] = verdict
         self.registry.counter("obs_health_checks_total",
                               "health sentinel checks ingested").inc()
         self.registry.info(
@@ -897,9 +942,12 @@ class RunMetrics:
                 # a deliberate stop, distinct from DONE and from any
                 # failure verdict (which all dominate it below)
                 verdict = "CANCELLED"
-            if (self.health or {}).get("verdict") == "DIVERGED":
+            if (self.health or {}).get("verdict") == "DIVERGED" or any(
+                    g.get("verdict") == "DIVERGED"
+                    for g in self.groups.values()):
                 # correctness dominates liveness: a run that diverged
-                # is lost no matter what the heartbeat says
+                # is lost no matter what the heartbeat says (coupled
+                # runs: ANY group's divergence is the run's)
                 verdict = "DIVERGED"
             out: Dict[str, Any] = {
                 "generated_at": time.time(),
@@ -923,6 +971,17 @@ class RunMetrics:
                 "summary": self.summary,
                 "errors": list(self.errors),
             }
+            if self.groups:
+                rank = {"DIVERGED": 0, "HEALTHY": 1}
+                rows = [{"group": name, **entry}
+                        for name, entry in self.groups.items()]
+                rows.sort(key=lambda r: rank.get(r.get("verdict"), 3))
+                worst = min(
+                    (r.get("verdict") for r in rows
+                     if r.get("verdict") is not None),
+                    key=lambda v: rank.get(v, 3), default=None)
+                out["groups"] = {"n_groups": len(rows), "rows": rows,
+                                 "worst_verdict": worst}
             if self.halo_audit is not None:
                 out["halo_audit"] = self.halo_audit
             if self.cancelled is not None:

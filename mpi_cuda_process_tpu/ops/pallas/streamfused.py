@@ -101,7 +101,7 @@ _BZ_LADDER = (32, 16, 8)
 
 def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
                  gshape, parity, origin_z, ins, outs, slabs,
-                 origin_y=0, yslabs=None, corners=None):
+                 origin_y=0, yslabs=None, corners=None, order=""):
     """One (y, x) strip: slide the z window down the local block, k
     micro-steps per chunk.
 
@@ -149,14 +149,20 @@ def _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
     # single strip spans the whole local y extent).
     wyc = Y if one_strip else by + 2 * wm_a
     wy = Y + 2 * wm_a if one_strip else wyc
-    yj = pl.program_id(0)
+    # swept traversal order (policy/autotune ``order``): "rev" walks the
+    # y strips high-to-low, "xy" makes the x windows the OUTER grid axis
+    # — strips write disjoint output slices, so any order is bit-exact;
+    # only the DMA locality pattern (what it costs) changes
+    yj = pl.program_id(1 if order == "xy" else 0)
+    if order == "rev":
+        yj = ny - 1 - yj
     ylo = 0 if one_strip else jnp.clip(yj * by - wm_a, 0, Y - wyc)
     if bx is None:
         wx, xlo, x_idx = X, 0, ()
         store_x, out_x = 0, ()
     else:
         wx = bx + 2 * _XSHELL
-        xj = pl.program_id(1)
+        xj = pl.program_id(0 if order == "xy" else 1)
         xlo = jnp.clip(xj * bx - _XSHELL, 0, X - wx)
         x_idx = (pl.ds(xlo, wx),)
         store_x, out_x = xj * bx - xlo, (pl.ds(xj * bx, bx),)
@@ -369,15 +375,16 @@ def _traced(v) -> bool:
 
 
 def _stream_kernel(micro, nfields, k, halo, wm, wm_a, bz, by, bx, shape,
-                   parity, *refs):
+                   parity, *refs, order=""):
     """Unsharded wrapper: ``refs`` = nfields input HBM refs then nfields
     output HBM refs (whole arrays, ``memory_space=ANY``)."""
     _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, shape,
-                 shape, parity, 0, refs[:nfields], refs[nfields:], None)
+                 shape, parity, 0, refs[:nfields], refs[nfields:], None,
+                 order=order)
 
 
 def _stream_sharded_kernel(micro, nfields, k, halo, wm, wm_a, bz, by, bx,
-                           lshape, gshape, parity, *refs):
+                           lshape, gshape, parity, *refs, order=""):
     """Sharded wrapper: ``refs`` = origins (SMEM int32 (2,)), then per
     field [core, slab_lo, slab_hi] HBM refs, then nfields outputs."""
     origins, refs = refs[0], refs[1:]
@@ -385,11 +392,12 @@ def _stream_sharded_kernel(micro, nfields, k, halo, wm, wm_a, bz, by, bx,
     slabs = [(refs[3 * f + 1], refs[3 * f + 2]) for f in range(nfields)]
     outs = refs[3 * nfields:]
     _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
-                 gshape, parity, origins[0], ins, outs, slabs)
+                 gshape, parity, origins[0], ins, outs, slabs,
+                 order=order)
 
 
 def _stream_2axis_kernel(micro, nfields, k, halo, wm, wm_a, bz, by, bx,
-                         lshape, gshape, parity, *refs):
+                         lshape, gshape, parity, *refs, order=""):
     """2-axis sharded wrapper: ``refs`` = origins (SMEM int32 (2,)), then
     per field [core, zslab_lo, zslab_hi, yslab_lo, yslab_hi, c_ll, c_lh,
     c_hl, c_hh] HBM refs (y slabs/corners pre-aligned to ``wm_a``
@@ -406,7 +414,8 @@ def _stream_2axis_kernel(micro, nfields, k, halo, wm, wm_a, bz, by, bx,
     outs = refs[per * nfields:]
     _stream_body(micro, nfields, k, halo, wm, wm_a, bz, by, bx, lshape,
                  gshape, parity, origins[0], ins, outs, slabs,
-                 origin_y=origins[1], yslabs=yslabs, corners=corners)
+                 origin_y=origins[1], yslabs=yslabs, corners=corners,
+                 order=order)
 
 
 def _pick_strip(Z, Y, X, wm, wm_a, itemsize, nfields, sharded=False,
@@ -514,15 +523,25 @@ def stream_supported(stencil: Stencil) -> bool:
 
 
 def _stream_gates(stencil, Lz, Y, X, k, tiles, sharded=False,
-                  two_axis=False):
+                  two_axis=False, margin=0):
     """Shared builder gates; returns
     ``(micro_factory, halo, nfields, wm, wm_a, bz, by, bx)`` or None —
-    ``bx`` is None for whole-lane strips, else the x-window extent."""
+    ``bx`` is None for whole-lane strips, else the x-window extent.
+
+    ``margin`` (policy/autotune ``margin``) overrides the sublane-rounded
+    temporal margin ``wm_a`` with a WIDER DMA-alignable y-flank — only a
+    sublane multiple covering the k-step halo ``wm`` is geometrically
+    valid (the extra columns are filler temporal validity excludes, so
+    any accepted margin is bit-exact; what changes is the DMA shape)."""
     micro_factory, halo, nfields = _MICRO[stencil.name]
     wm = k * _halo_per_micro(stencil)
     itemsize = jnp.dtype(stencil.dtype).itemsize
     sub = _sublane(itemsize)
     wm_a = -(-wm // sub) * sub  # margin rounded to a DMA-alignable offset
+    if margin:
+        if margin % sub or margin < wm:
+            return None
+        wm_a = int(margin)
     if tiles is None:
         tiles = _pick_strip(Lz, Y, X, wm, wm_a, itemsize, nfields,
                             sharded=sharded, two_axis=two_axis)
@@ -555,6 +574,8 @@ def build_stream_sharded_call(
     tiles: Optional[Tuple[int, ...]] = None,  # (bz, by[, bx])
     interpret: Optional[bool] = None,
     periodic: bool = False,
+    margin: int = 0,
+    order: str = "",
 ):
     """Streaming kernel over a z-decomposed LOCAL block: the config-5
     execution with sliding-window traffic.
@@ -581,18 +602,24 @@ def build_stream_sharded_call(
         interpret = _interpret_default()
     Lz, Y, X = (int(s) for s in local_shape)
     gshape = tuple(int(s) for s in global_shape)
-    gates = _stream_gates(stencil, Lz, Y, X, k, tiles, sharded=True)
+    gates = _stream_gates(stencil, Lz, Y, X, k, tiles, sharded=True,
+                          margin=margin)
     if gates is None:
         return None
     micro_factory, halo, nfields, wm, wm_a, bz, by, bx = gates
+    if order not in ("", "rev") and not (order == "xy"
+                                         and bx is not None):
+        return None  # "xy" permutes a 2-d strip grid only
     micro = micro_factory(stencil, interpret)
     parity = bool(stencil.phases)
 
     def kernel(*refs):
         _stream_sharded_kernel(micro, nfields, k, halo, wm, wm_a, bz, by,
-                               bx, (Lz, Y, X), gshape, parity, *refs)
+                               bx, (Lz, Y, X), gshape, parity, *refs,
+                               order=order)
 
-    grid = (Y // by,) if bx is None else (Y // by, X // bx)
+    grid = (Y // by,) if bx is None else (
+        (X // bx, Y // by) if order == "xy" else (Y // by, X // bx))
     call = pl.pallas_call(
         kernel,
         grid=grid,
@@ -617,6 +644,8 @@ def build_stream_2axis_call(
     tiles: Optional[Tuple[int, ...]] = None,  # (bz, by[, bx])
     interpret: Optional[bool] = None,
     periodic: bool = False,
+    margin: int = 0,
+    order: str = "",
 ):
     """Streaming kernel over a (z, y)- or y-decomposed LOCAL block — the
     2-axis generalization of ``build_stream_sharded_call``, closing the
@@ -653,18 +682,23 @@ def build_stream_2axis_call(
     Lz, Ly, X = (int(s) for s in local_shape)
     gshape = tuple(int(s) for s in global_shape)
     gates = _stream_gates(stencil, Lz, Ly, X, k, tiles, sharded=True,
-                          two_axis=True)
+                          two_axis=True, margin=margin)
     if gates is None:
         return None
     micro_factory, halo, nfields, wm, wm_a, bz, by, bx = gates
+    if order not in ("", "rev") and not (order == "xy"
+                                         and bx is not None):
+        return None  # "xy" permutes a 2-d strip grid only
     micro = micro_factory(stencil, interpret)
     parity = bool(stencil.phases)
 
     def kernel(*refs):
         _stream_2axis_kernel(micro, nfields, k, halo, wm, wm_a, bz, by,
-                             bx, (Lz, Ly, X), gshape, parity, *refs)
+                             bx, (Lz, Ly, X), gshape, parity, *refs,
+                             order=order)
 
-    grid = (Ly // by,) if bx is None else (Ly // by, X // bx)
+    grid = (Ly // by,) if bx is None else (
+        (X // bx, Ly // by) if order == "xy" else (Ly // by, X // bx))
     pallas = pl.pallas_call(
         kernel,
         grid=grid,
@@ -712,6 +746,8 @@ def make_stream_fused_step(
     tiles: Optional[Tuple[int, ...]] = None,  # (bz, by[, bx])
     interpret: Optional[bool] = None,
     batch: int = 0,
+    margin: int = 0,
+    order: str = "",
 ):
     """Build ``fields -> fields`` advancing ``k`` steps in one streaming
     pass, or None when the shape can't host the sliding window.
@@ -737,18 +773,22 @@ def make_stream_fused_step(
     if interpret is None:
         interpret = _interpret_default()
     Z, Y, X = (int(s) for s in global_shape)
-    gates = _stream_gates(stencil, Z, Y, X, k, tiles)
+    gates = _stream_gates(stencil, Z, Y, X, k, tiles, margin=margin)
     if gates is None:
         return None
     micro_factory, halo, nfields, wm, wm_a, bz, by, bx = gates
+    if order not in ("", "rev") and not (order == "xy"
+                                         and bx is not None):
+        return None  # "xy" permutes a 2-d strip grid only
     micro = micro_factory(stencil, interpret)
     parity = bool(stencil.phases)
 
     def kernel(*refs):
         _stream_kernel(micro, nfields, k, halo, wm, wm_a, bz, by, bx,
-                       (Z, Y, X), parity, *refs)
+                       (Z, Y, X), parity, *refs, order=order)
 
-    grid = (Y // by,) if bx is None else (Y // by, X // bx)
+    grid = (Y // by,) if bx is None else (
+        (X // bx, Y // by) if order == "xy" else (Y // by, X // bx))
     call = pl.pallas_call(
         kernel,
         grid=grid,

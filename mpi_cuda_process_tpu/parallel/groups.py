@@ -1,0 +1,758 @@
+"""MPMD on one slice: device groups running heterogeneous physics.
+
+Every run before this module drove the whole device slice in lockstep
+SPMD — one op, one resolution, one dtype — so chips over the "easy"
+far-field burned the same cycles as chips over the hard region.  Here
+the slice is partitioned into N contiguous DEVICE GROUPS along the
+leading grid axis, each running its own per-group config:
+
+* a different op (a ``wave3d`` hot region embedded in a ``heat3d``
+  far-field),
+* a different resolution (an integer power-of-two refinement ratio,
+  with block-mean restriction / piecewise-constant interpolation at
+  the interface), or
+* a different dtype (a bf16 hot region inside an f32 shell),
+
+coupled ONLY at interface faces.  Each group's interior step is the
+UNMODIFIED existing stepper (:func:`..parallel.stepper.make_sharded_step`
+over a sub-mesh built from that group's devices), so every intra-group
+capability — sharded meshes, 2-axis decompositions — composes per
+group, and the interface exchange is the only new traffic.
+
+Coupling mechanism (the ghost BAND):
+
+Each group's local grid carries, on each interior-facing side, a band
+of ``m = halo * max(1, phases)`` extra rows (in the group's own
+resolution units) past its owned region.  Once per round the band is
+overwritten WHOLESALE with the neighbor group's owned boundary rows —
+sliced on the sender, resampled across resolution ratios, cast across
+dtypes, and moved with a plain ``jax.device_put`` (groups live on
+disjoint devices under different meshes, so no collective can span
+them; ``jaxprcheck.assert_coupled_structure`` pins this).  During the
+group's step the stepper's own guard-frame re-pin freezes the band's
+outermost ``halo`` rows (the group grid IS the stepper's global
+shape), and staleness propagates inward at ``halo`` rows per phase —
+so after one step exactly the band is stale and every OWNED row is
+bit-identical to the monolithic run's value.  That is the load-bearing
+invariant: a 2-group same-physics split is bit-exact against the
+monolithic run (tests/test_groups.py), and heterogeneity degrades
+gracefully from there.
+
+Resampling is exact where it can be: restriction is iterated pairwise
+averaging (power-of-two ratios only, rejected otherwise by name), so
+``restrict(interpolate(x)) == x`` bitwise — the conservation pin.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..config import groups_signature
+from ..driver import make_runner
+from ..ops.stencil import Fields, Stencil, make_stencil
+from ..utils.init import init_state
+from . import mesh as mesh_lib
+from . import stepper as stepper_lib
+
+# The cross-group transport.  Groups run under DIFFERENT meshes on
+# disjoint devices, so no named-axis collective can carry the band;
+# the honest backend tag for what actually moves the bytes.
+TRANSPORT_BACKEND = "device_put"
+
+_DTYPE_ALIASES = {
+    "f32": "float32", "float32": "float32",
+    "bf16": "bfloat16", "bfloat16": "bfloat16",
+    "f16": "float16", "float16": "float16",
+    "f64": "float64", "float64": "float64",
+}
+
+_GROUP_RE = re.compile(
+    r"^(?P<head>[^@]+)@(?P<d0>\d+)(?:-(?P<d1>\d+))?(?P<tail>(?::[^:,]+)*)$")
+
+
+# ---------------------------------------------------------------------------
+# Spec parsing: the --groups grammar
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupSpec:
+    """One group's requested config, straight from the ``--groups`` string.
+
+    Grammar (comma-separated, one clause per group)::
+
+        <op>[:fine[R]|:coarse][:<dtype>]@<d0>[-<d1>][:z<num>/<den>][:mesh<m0>x<m1>...]
+
+    e.g. ``"wave3d:fine@0-3:z1/4,heat3d:coarse@4-7"``: a 2x-refined
+    wave3d hot region over the first quarter of the z axis on devices
+    0-3, and a base-resolution heat3d far-field on devices 4-7.
+    """
+
+    op: str
+    ratio: int = 1             # refinement vs the base grid; power of two
+    dtype: str = ""            # "" -> the run's default dtype
+    dev_lo: int = 0
+    dev_hi: int = 0            # inclusive
+    z_num: int = 0             # 0/0 -> even share of the unclaimed rows
+    z_den: int = 0
+    mesh: Tuple[int, ...] = () # per-group mesh shape; () -> (n_devices,)
+
+    @property
+    def n_devices(self) -> int:
+        return self.dev_hi - self.dev_lo + 1
+
+
+def parse_groups(spec: str, n_devices: Optional[int] = None
+                 ) -> Tuple[GroupSpec, ...]:
+    """Parse a ``--groups`` string into validated :class:`GroupSpec` s.
+
+    Every rejection is NAMED — a malformed clause never degrades into a
+    silently-monolithic run.
+    """
+    clauses = [c.strip() for c in (spec or "").split(",") if c.strip()]
+    if len(clauses) < 2:
+        raise ValueError(
+            f"--groups needs at least 2 comma-separated groups, got "
+            f"{len(clauses)} in {spec!r}")
+    out: List[GroupSpec] = []
+    for clause in clauses:
+        m = _GROUP_RE.match(clause)
+        if m is None:
+            raise ValueError(
+                f"--groups clause {clause!r} does not match "
+                "<op>[:fine[R]|:coarse][:<dtype>]@<d0>-<d1>"
+                "[:z<num>/<den>][:mesh<m0>x<m1>...]")
+        head = m.group("head").split(":")
+        op, ratio, dtype = head[0], 1, ""
+        for tok in head[1:]:
+            if tok == "coarse":
+                ratio = 1
+            elif tok.startswith("fine"):
+                ratio = int(tok[4:]) if tok[4:] else 2
+                if ratio < 2 or ratio & (ratio - 1):
+                    raise ValueError(
+                        f"--groups clause {clause!r}: refinement ratio "
+                        f"{ratio} must be a power of two >= 2 (bitwise "
+                        "restriction/interpolation round-trips need it)")
+            elif tok in _DTYPE_ALIASES:
+                dtype = _DTYPE_ALIASES[tok]
+            else:
+                raise ValueError(
+                    f"--groups clause {clause!r}: unknown qualifier "
+                    f"{tok!r} (expected fine[R], coarse, or a dtype in "
+                    f"{sorted(set(_DTYPE_ALIASES))})")
+        d0 = int(m.group("d0"))
+        d1 = int(m.group("d1")) if m.group("d1") is not None else d0
+        if d1 < d0:
+            raise ValueError(
+                f"--groups clause {clause!r}: device range {d0}-{d1} "
+                "is descending")
+        z_num = z_den = 0
+        gmesh: Tuple[int, ...] = ()
+        for tok in [t for t in m.group("tail").split(":") if t]:
+            if tok.startswith("mesh"):
+                try:
+                    gmesh = tuple(int(x) for x in tok[4:].split("x"))
+                except ValueError:
+                    raise ValueError(
+                        f"--groups clause {clause!r}: bad mesh spec "
+                        f"{tok!r} (expected mesh<m0>x<m1>...)") from None
+            elif tok.startswith("z"):
+                fm = re.match(r"^z(\d+)/(\d+)$", tok)
+                if fm is None:
+                    raise ValueError(
+                        f"--groups clause {clause!r}: bad z-fraction "
+                        f"{tok!r} (expected z<num>/<den>)")
+                z_num, z_den = int(fm.group(1)), int(fm.group(2))
+                if z_den == 0 or not 0 < z_num < z_den:
+                    raise ValueError(
+                        f"--groups clause {clause!r}: z-fraction "
+                        f"{z_num}/{z_den} must lie strictly in (0, 1)")
+            else:
+                raise ValueError(
+                    f"--groups clause {clause!r}: unknown suffix {tok!r} "
+                    "(expected :z<num>/<den> or :mesh<m0>x<m1>...)")
+        if gmesh and int(np.prod(gmesh)) != (d1 - d0 + 1):
+            raise ValueError(
+                f"--groups clause {clause!r}: mesh {gmesh} needs "
+                f"{int(np.prod(gmesh))} devices but the range {d0}-{d1} "
+                f"holds {d1 - d0 + 1}")
+        out.append(GroupSpec(op=op, ratio=ratio, dtype=dtype, dev_lo=d0,
+                             dev_hi=d1, z_num=z_num, z_den=z_den,
+                             mesh=gmesh))
+    out.sort(key=lambda s: s.dev_lo)
+    if out[0].dev_lo != 0:
+        raise ValueError(
+            f"--groups device ranges must start at device 0 "
+            f"(first group starts at {out[0].dev_lo})")
+    for a, b in zip(out, out[1:]):
+        if b.dev_lo != a.dev_hi + 1:
+            raise ValueError(
+                f"--groups device ranges must be contiguous and "
+                f"disjoint: group at {a.dev_lo}-{a.dev_hi} is followed "
+                f"by {b.dev_lo}-{b.dev_hi}")
+    if n_devices is not None and out[-1].dev_hi + 1 > n_devices:
+        raise ValueError(
+            f"--groups needs devices 0-{out[-1].dev_hi} but only "
+            f"{n_devices} device(s) are available")
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Geometry planning
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupPlan:
+    """One group's resolved geometry: grids, bands, devices, mesh."""
+
+    index: int
+    spec: GroupSpec
+    stencil: Stencil
+    base_z0: int          # owned range on the BASE-resolution z axis
+    base_z1: int
+    band_lo: int          # ghost-band rows (own units); 0 at a true wall
+    band_hi: int
+    grid: Tuple[int, ...]        # local grid incl. bands, own units
+    mesh_shape: Tuple[int, ...]
+
+    @property
+    def name(self) -> str:
+        return f"g{self.index}:{self.spec.op}"
+
+    @property
+    def ratio(self) -> int:
+        return self.spec.ratio
+
+    @property
+    def owned_z(self) -> Tuple[int, int]:
+        """Owned z range in LOCAL (own-resolution) row indices."""
+        n_owned = (self.base_z1 - self.base_z0) * self.spec.ratio
+        return (self.band_lo, self.band_lo + n_owned)
+
+    @property
+    def cells(self) -> int:
+        """Cells the group's step actually computes (incl. bands)."""
+        return int(np.prod(self.grid))
+
+    @property
+    def owned_cells(self) -> int:
+        z0, z1 = self.owned_z
+        return int((z1 - z0) * np.prod(self.grid[1:]))
+
+    def devices(self) -> List[jax.Device]:
+        return list(jax.devices()[self.spec.dev_lo:self.spec.dev_hi + 1])
+
+    def describe(self) -> Dict[str, Any]:
+        """The manifest/costmodel-facing description of this group."""
+        return {
+            "group": self.name,
+            "op": self.spec.op,
+            "ratio": self.spec.ratio,
+            "dtype": str(np.dtype(self.stencil.dtype)),
+            "devices": [self.spec.dev_lo, self.spec.dev_hi],
+            "mesh": list(self.mesh_shape),
+            "grid": list(self.grid),
+            "base_z": [self.base_z0, self.base_z1],
+            "band": [self.band_lo, self.band_hi],
+        }
+
+
+def _band_width(st: Stencil) -> int:
+    """Ghost-band rows per interior-facing side, in the group's units.
+
+    One step pollutes ``halo`` rows per phase inward from the frozen
+    guard frame, so a band of ``halo * phases`` rows absorbs exactly
+    one round's staleness and every owned row stays exact.
+    """
+    return st.halo * max(1, len(st.phases or ()))
+
+
+def plan_groups(specs: Sequence[GroupSpec], base_grid: Sequence[int],
+                default_dtype: Optional[str] = None,
+                ) -> Tuple[GroupPlan, ...]:
+    """Resolve specs against the BASE grid into per-group geometry.
+
+    ``base_grid`` is the coarse/base-resolution global grid; group g's
+    local grid scales every axis by its ratio and appends the ghost
+    bands along axis 0.
+    """
+    base_grid = tuple(int(g) for g in base_grid)
+    Z = base_grid[0]
+    # -- z extents: explicit fractions first, even split of the rest --
+    extents: List[Optional[int]] = []
+    claimed = 0
+    for s in specs:
+        if s.z_den:
+            rows = Z * s.z_num
+            if rows % s.z_den:
+                raise ValueError(
+                    f"--groups: z-fraction {s.z_num}/{s.z_den} of the "
+                    f"{Z}-row base axis is not an integer row count")
+            extents.append(rows // s.z_den)
+            claimed += rows // s.z_den
+        else:
+            extents.append(None)
+    free = [i for i, e in enumerate(extents) if e is None]
+    rest = Z - claimed
+    if free:
+        if rest <= 0 or rest % len(free):
+            raise ValueError(
+                f"--groups: {rest} unclaimed base rows do not split "
+                f"evenly among {len(free)} group(s) without an explicit "
+                "z-fraction")
+        for i in free:
+            extents[i] = rest // len(free)
+    elif rest != 0:
+        raise ValueError(
+            f"--groups: z-fractions cover {claimed} of {Z} base rows "
+            "(must sum to exactly 1)")
+    plans: List[GroupPlan] = []
+    z0 = 0
+    ndim = None
+    for i, (s, ext) in enumerate(zip(specs, extents)):
+        kwargs: Dict[str, Any] = {}
+        if s.dtype or default_dtype:
+            kwargs["dtype"] = jnp.dtype(s.dtype or default_dtype)
+        st = make_stencil(s.op, **kwargs)
+        if ndim is None:
+            ndim = st.ndim
+        elif st.ndim != ndim:
+            raise ValueError(
+                f"--groups mixes {ndim}D and {st.ndim}D ops "
+                f"({specs[0].op} vs {s.op}) — all groups must share the "
+                "grid rank")
+        if len(base_grid) != st.ndim:
+            raise ValueError(
+                f"--groups: {s.op} is {st.ndim}D but the base grid "
+                f"{base_grid} has rank {len(base_grid)}")
+        m = _band_width(st)
+        band_lo = m if i > 0 else 0
+        band_hi = m if i < len(specs) - 1 else 0
+        if ext * s.ratio <= band_lo + band_hi:
+            raise ValueError(
+                f"--groups: group {i} ({s.op}) owns only {ext} base "
+                f"row(s) — fewer than its own ghost bands "
+                f"({band_lo}+{band_hi} rows); give it a larger "
+                ":z fraction")
+        grid = ((ext * s.ratio + band_lo + band_hi,)
+                + tuple(g * s.ratio for g in base_grid[1:]))
+        mesh_shape = s.mesh or (s.n_devices,)
+        if len(mesh_shape) > st.ndim:
+            raise ValueError(
+                f"--groups: group {i} mesh {mesh_shape} has more axes "
+                f"than the {st.ndim}D grid")
+        plans.append(GroupPlan(
+            index=i, spec=s, stencil=st, base_z0=z0, base_z1=z0 + ext,
+            band_lo=band_lo, band_hi=band_hi, grid=grid,
+            mesh_shape=tuple(mesh_shape)))
+        z0 += ext
+    # Neighbor-pair feasibility: the receiver's band must be servable
+    # from the sender's OWNED rows, resampled across the ratio pair.
+    for a, b in zip(plans, plans[1:]):
+        ra, rb = a.spec.ratio, b.spec.ratio
+        if (ra % rb) and (rb % ra):
+            raise ValueError(
+                f"--groups: neighbor ratios {ra} and {rb} "
+                f"({a.name} | {b.name}) must divide one another for "
+                "face resampling")
+        for recv, send in ((a, b), (b, a)):
+            m = recv.band_hi if recv is a else recv.band_lo
+            need = -(-m * send.spec.ratio // recv.spec.ratio)  # ceil
+            oz0, oz1 = send.owned_z
+            if need > oz1 - oz0:
+                raise ValueError(
+                    f"--groups: {recv.name}'s {m}-row band needs {need} "
+                    f"owned row(s) from {send.name}, which owns only "
+                    f"{oz1 - oz0}")
+    return tuple(plans)
+
+
+# ---------------------------------------------------------------------------
+# Face resampling: exact where exactness is claimed
+# ---------------------------------------------------------------------------
+
+
+def interpolate(x: jax.Array, factor: int) -> jax.Array:
+    """Coarse -> fine: piecewise-constant repeat along every axis."""
+    if factor == 1:
+        return x
+    for ax in range(x.ndim):
+        x = jnp.repeat(x, factor, axis=ax)
+    return x
+
+
+def restrict(x: jax.Array, factor: int) -> jax.Array:
+    """Fine -> coarse: block mean by iterated pairwise averaging.
+
+    Power-of-two factors only: ``(a + b) * 0.5`` of equal values is
+    exact in every IEEE dtype, so ``restrict(interpolate(x)) == x``
+    BITWISE — the interface conservation pin.  (A reshape-and-sum mean
+    would round: summing four equal f32 values sequentially already
+    loses bits at 3x.)
+    """
+    if factor == 1:
+        return x
+    if factor & (factor - 1):
+        raise ValueError(
+            f"restriction factor {factor} must be a power of two")
+    half = jnp.asarray(0.5, x.dtype)
+    while factor > 1:
+        for ax in range(x.ndim):
+            lo = [slice(None)] * x.ndim
+            hi = [slice(None)] * x.ndim
+            lo[ax] = slice(0, None, 2)
+            hi[ax] = slice(1, None, 2)
+            x = (x[tuple(lo)] + x[tuple(hi)]) * half
+        factor //= 2
+    return x
+
+
+# ---------------------------------------------------------------------------
+# The coupled runner
+# ---------------------------------------------------------------------------
+
+
+def _zslice(x, sl: slice):
+    return x[(sl,) + (slice(None),) * (x.ndim - 1)]
+
+
+def _band_spec(ndim: int, mesh) -> PartitionSpec:
+    """A band's sharding on the receiver: like the fields, z unsharded."""
+    spec = list(stepper_lib.grid_partition_spec(ndim, mesh))
+    spec[0] = None
+    return PartitionSpec(*spec)
+
+
+class CoupledRunner:
+    """N groups, each on its own sub-mesh, coupled at interface faces.
+
+    Host-orchestrated round loop: per round, every interface band is
+    refreshed from its neighbor's owned rows (slice -> resample ->
+    cast -> ``device_put`` -> splice), then every group's jitted
+    runner is dispatched — JAX async dispatch runs the groups
+    concurrently on their disjoint devices, which is the MPMD.
+    """
+
+    def __init__(self, plans: Sequence[GroupPlan], seed: int = 0,
+                 density: float = 0.15, init_kind: str = "auto"):
+        self.plans = tuple(plans)
+        self.n_groups = len(self.plans)
+        self.round = 0
+        self.meshes = []
+        self.fields: List[Fields] = []
+        self._step_fns = []
+        self._runners = []
+        for p in self.plans:
+            msh = mesh_lib.make_mesh(p.mesh_shape, devices=p.devices())
+            self.meshes.append(msh)
+            step = stepper_lib.make_sharded_step(p.stencil, msh, p.grid)
+            self._step_fns.append(step)
+            self._runners.append(make_runner(step, 1))
+            self.fields.append(self._init_group(p, msh, seed, density,
+                                                init_kind))
+        self._sends, self._splices = self._build_transfers()
+
+    # -- construction ---------------------------------------------------
+
+    def _init_group(self, p: GroupPlan, msh, seed, density, kind) -> Fields:
+        """Globally-consistent init: slice the op's GLOBAL init.
+
+        Each group initializes from ``init_state`` on the full global
+        grid AT ITS OWN RESOLUTION and slices its local z rows — so a
+        same-physics split starts from bit-identical state to the
+        monolithic run, and heterogeneous groups still agree on the
+        shared geometry.  (The full-resolution init is transient.)
+        """
+        r = p.spec.ratio
+        global_grid = (self._base_z_total() * r,) + p.grid[1:]
+        full = init_state(p.stencil, global_grid, seed=seed,
+                          density=density, kind=kind)
+        z0 = p.base_z0 * r - p.band_lo
+        z1 = p.base_z1 * r + p.band_hi
+        spec = stepper_lib.grid_partition_spec(p.stencil.ndim, msh)
+        sharding = NamedSharding(msh, spec)
+        return tuple(jax.device_put(_zslice(f, slice(z0, z1)), sharding)
+                     for f in full)
+
+    def _base_z_total(self) -> int:
+        return self.plans[-1].base_z1
+
+    def _build_transfers(self):
+        """Per-interface jitted send fns + per-group donating splices.
+
+        ``sends[k] = (send_up, send_dn)`` for the interface between
+        groups k and k+1: ``send_up`` maps group k's fields to group
+        k+1's low band (already resampled/cast, still on the sender);
+        ``send_dn`` is the mirror.  ``splices[g]`` takes group g's
+        fields plus its (lo, hi) band lists and writes them in place
+        (donated).
+        """
+        sends = []
+        for lo, hi in zip(self.plans, self.plans[1:]):
+            sends.append((self._make_send(lo, hi, up=True),
+                          self._make_send(hi, lo, up=False)))
+        splices = [self._make_splice(p) for p in self.plans]
+        return sends, splices
+
+    def _exchange_idx(self, send: GroupPlan, recv: GroupPlan) -> List[int]:
+        """Field indices carried across this interface.
+
+        Per-field pairing by index up to the smaller field count; only
+        halo-bearing receiver fields need band data (a field whose
+        neighbors are never read — wave's ``u_prev`` — keeps its own
+        frame-pinned rows).
+        """
+        n = min(send.stencil.num_fields, recv.stencil.num_fields)
+        return [i for i in range(n) if recv.stencil.field_halos[i] > 0]
+
+    def _make_send(self, send: GroupPlan, recv: GroupPlan, up: bool):
+        """Jitted sender-side transfer: slice owned rows, resample, cast."""
+        m = recv.band_lo if up else recv.band_hi
+        rs, rr = send.spec.ratio, recv.spec.ratio
+        oz0, oz1 = send.owned_z
+        if rs >= rr:
+            f = rs // rr
+            n_src = m * f
+        else:
+            f = rr // rs
+            n_src = -(-m // f)  # ceil: interpolation may overshoot
+        # the sender rows adjacent to the interface
+        src = (slice(oz1 - n_src, oz1) if up else slice(oz0, oz0 + n_src))
+        idx = self._exchange_idx(send, recv)
+        dtype = recv.stencil.dtype
+
+        def transfer(fields: Fields) -> Fields:
+            out = []
+            for i in idx:
+                x = _zslice(fields[i], src)
+                if rs > rr:
+                    x = restrict(x, rs // rr)
+                elif rr > rs:
+                    x = interpolate(x, rr // rs)
+                    # keep the m rows adjacent to the interface
+                    n = x.shape[0]
+                    x = _zslice(x, slice(n - m, n) if up else slice(0, m))
+                out.append(x.astype(dtype))
+            return tuple(out)
+
+        return jax.jit(transfer)
+
+    def _make_splice(self, p: GroupPlan):
+        """Donating band write for group ``p``: fields, lo/hi bands -> fields."""
+        nz = p.grid[0]
+        lo_sl = slice(0, p.band_lo)
+        hi_sl = slice(nz - p.band_hi, nz)
+        lo_idx = (self._exchange_idx(self.plans[p.index - 1], p)
+                  if p.band_lo else [])
+        hi_idx = (self._exchange_idx(self.plans[p.index + 1], p)
+                  if p.band_hi else [])
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def splice(fields: Fields, lo_bands: Fields, hi_bands: Fields):
+            fs = list(fields)
+            for i, b in zip(lo_idx, lo_bands):
+                fs[i] = fs[i].at[lo_sl].set(b)
+            for i, b in zip(hi_idx, hi_bands):
+                fs[i] = fs[i].at[hi_sl].set(b)
+            return tuple(fs)
+
+        return splice
+
+    # -- the round loop -------------------------------------------------
+
+    def exchange(self) -> None:
+        """Refresh every interface band from its neighbor's owned rows.
+
+        All sends are computed (and moved) BEFORE any splice runs: the
+        splices donate their input buffers, so every read of the
+        pre-round state must land first.
+        """
+        staged_lo: List[Fields] = [() for _ in self.plans]
+        staged_hi: List[Fields] = [() for _ in self.plans]
+        for k, (send_up, send_dn) in enumerate(self._sends):
+            lo, hi = self.plans[k], self.plans[k + 1]
+            up = send_up(self.fields[k])
+            dn = send_dn(self.fields[k + 1])
+            spec_hi = _band_spec(hi.stencil.ndim, self.meshes[k + 1])
+            spec_lo = _band_spec(lo.stencil.ndim, self.meshes[k])
+            staged_lo[k + 1] = tuple(
+                jax.device_put(b, NamedSharding(self.meshes[k + 1], spec_hi))
+                for b in up)
+            staged_hi[k] = tuple(
+                jax.device_put(b, NamedSharding(self.meshes[k], spec_lo))
+                for b in dn)
+        for g in range(self.n_groups):
+            if staged_lo[g] or staged_hi[g]:
+                self.fields[g] = self._splices[g](
+                    self.fields[g], staged_lo[g], staged_hi[g])
+
+    def step_round(self) -> None:
+        """One coupled round: exchange, then every group steps once.
+
+        The per-group dispatches return immediately (JAX async); the
+        groups' device programs overlap on their disjoint devices.
+        """
+        self.exchange()
+        self.fields = [runner(f) for runner, f in
+                       zip(self._runners, self.fields)]
+        self.round += 1
+
+    def run(self, rounds: int, on_round=None) -> None:
+        for _ in range(int(rounds)):
+            self.step_round()
+            if on_round is not None:
+                on_round(self)
+
+    def block_until_ready(self) -> None:
+        for fs in self.fields:
+            for f in fs:
+                f.block_until_ready()
+
+    # -- inspection / gates ---------------------------------------------
+
+    def step_jaxprs(self):
+        """Per-group step jaxprs (for ``assert_coupled_structure``)."""
+        return [jax.make_jaxpr(step)(tuple(f))
+                for step, f in zip(self._step_fns, self.fields)]
+
+    def transfer_jaxprs(self):
+        """Interface transfer jaxprs: slice+resample+cast, per direction."""
+        out = []
+        for k, (send_up, send_dn) in enumerate(self._sends):
+            out.append(jax.make_jaxpr(send_up)(tuple(self.fields[k])))
+            out.append(jax.make_jaxpr(send_dn)(tuple(self.fields[k + 1])))
+        return out
+
+    def sharded_group_indices(self) -> List[int]:
+        """Groups whose sub-mesh actually shards an axis (> 1 shard)."""
+        return [i for i, p in enumerate(self.plans)
+                if any(c > 1 for c in p.mesh_shape)]
+
+    # -- accounting ------------------------------------------------------
+
+    def cell_updates_per_round(self) -> int:
+        """Cells actually computed per round, summed over groups."""
+        return sum(p.cells for p in self.plans)
+
+    # -- assembly ---------------------------------------------------------
+
+    def assemble(self) -> Tuple[np.ndarray, ...]:
+        """Base-resolution global fields: restrict fine groups, concat owned.
+
+        Field indices present in EVERY group only (heterogeneous
+        interiors have no global single-op view beyond those).
+        """
+        n = min(p.stencil.num_fields for p in self.plans)
+        out = []
+        for i in range(n):
+            parts = []
+            for p, fs in zip(self.plans, self.fields):
+                z0, z1 = p.owned_z
+                owned = _zslice(fs[i], slice(z0, z1))
+                if p.spec.ratio > 1:
+                    owned = restrict(owned, p.spec.ratio)
+                parts.append(np.asarray(jax.device_get(owned)))
+            out.append(np.concatenate(parts, axis=0))
+        return tuple(out)
+
+    # -- checkpoint / resume ---------------------------------------------
+
+    def save_checkpoint(self, path: str) -> None:
+        from ..utils import checkpointing
+
+        step = self.round
+        for p, fs in zip(self.plans, self.fields):
+            checkpointing.save_checkpoint(
+                os.path.join(path, f"group{p.index}"), fs, step,
+                config={"group": p.describe()})
+
+    def load_checkpoint(self, path: str) -> int:
+        from ..utils import checkpointing
+
+        steps = set()
+        for g, p in enumerate(self.plans):
+            fields, step, _ = checkpointing.load_checkpoint(
+                os.path.join(path, f"group{p.index}"))
+            if tuple(fields[0].shape) != tuple(self.plans[g].grid):
+                raise ValueError(
+                    f"coupled checkpoint group {p.name}: saved grid "
+                    f"{tuple(fields[0].shape)} != planned {p.grid}")
+            spec = stepper_lib.grid_partition_spec(p.stencil.ndim,
+                                                   self.meshes[g])
+            sharding = NamedSharding(self.meshes[g], spec)
+            self.fields[g] = tuple(
+                jax.device_put(jnp.asarray(f, p.stencil.dtype), sharding)
+                for f in fields)
+            steps.add(int(step))
+        if len(steps) != 1:
+            raise ValueError(
+                f"coupled checkpoint groups disagree on step: {sorted(steps)}")
+        self.round = steps.pop()
+        return self.round
+
+
+# ---------------------------------------------------------------------------
+# Interface traffic accounting (budget/costmodel feed)
+# ---------------------------------------------------------------------------
+
+
+def interface_traffic(plans: Sequence[GroupPlan]) -> List[Dict[str, Any]]:
+    """Per-interface transfer accounting: bytes per round, per direction.
+
+    Each direction's cost is the RECEIVER-side band (what device_put
+    actually lands) plus the sender-side staging slice — the transient
+    the budget must price.
+    """
+    out = []
+    for lo, hi in zip(plans, plans[1:]):
+        entry: Dict[str, Any] = {
+            "interface": f"{lo.name}|{hi.name}",
+            "ratio": [lo.spec.ratio, hi.spec.ratio],
+            "dtypes": [str(np.dtype(lo.stencil.dtype)),
+                       str(np.dtype(hi.stencil.dtype))],
+        }
+        for direction, send, recv in (("up", lo, hi), ("down", hi, lo)):
+            m = recv.band_lo if direction == "up" else recv.band_hi
+            n_fields = len([i for i in range(
+                min(send.stencil.num_fields, recv.stencil.num_fields))
+                if recv.stencil.field_halos[i] > 0])
+            band_cells = m * int(np.prod(recv.grid[1:]))
+            recv_bytes = (band_cells * np.dtype(recv.stencil.dtype).itemsize
+                          * n_fields)
+            f = max(send.spec.ratio // recv.spec.ratio, 1)
+            n_src = (m * f if send.spec.ratio >= recv.spec.ratio
+                     else -(-m * send.spec.ratio // recv.spec.ratio))
+            send_bytes = (n_src * int(np.prod(send.grid[1:]))
+                          * np.dtype(send.stencil.dtype).itemsize * n_fields)
+            entry[direction] = {"fields": n_fields,
+                                "recv_bytes": int(recv_bytes),
+                                "send_bytes": int(send_bytes)}
+        out.append(entry)
+    return out
+
+
+def plans_from_config(groups: str, base_grid: Sequence[int],
+                      default_dtype: Optional[str] = None,
+                      n_devices: Optional[int] = None
+                      ) -> Tuple[GroupPlan, ...]:
+    """The one-call config -> plans path every entry point shares."""
+    specs = parse_groups(groups, n_devices=n_devices)
+    return plan_groups(specs, base_grid, default_dtype=default_dtype)
+
+
+__all__ = [
+    "GroupSpec", "GroupPlan", "CoupledRunner", "parse_groups",
+    "plan_groups", "plans_from_config", "interpolate", "restrict",
+    "interface_traffic", "groups_signature", "TRANSPORT_BACKEND",
+]

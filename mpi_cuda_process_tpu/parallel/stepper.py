@@ -814,6 +814,11 @@ def _make_zslab_padfree_step(stencil, mesh, global_shape, local_shape,
         # (layout (1, 1)) accepts tiles; other layouts never see variants
         # (make_sharded_fused_step rejects them before dispatch)
         build_kw["tiles"] = variant.tiles
+    if variant is not None and layout == (1, 1):
+        if getattr(variant, "margin", 0):
+            build_kw["margin"] = variant.margin
+        if getattr(variant, "order", ""):
+            build_kw["order"] = variant.order
     built = build_call(stencil, local_shape, gshape, k,
                        interpret=interpret, periodic=periodic, **build_kw)
     if built is None:
@@ -1087,9 +1092,11 @@ def _make_yzslab_padfree_step(stencil, mesh, global_shape, local_shape,
         kind_name = "stream_yz"
         tiles = (variant.tiles if variant is not None and variant.tiles
                  else None)
-        built = build_stream_2axis_call(stencil, local_shape, gshape, k,
-                                        tiles=tiles, interpret=interpret,
-                                        periodic=periodic)
+        built = build_stream_2axis_call(
+            stencil, local_shape, gshape, k, tiles=tiles,
+            interpret=interpret, periodic=periodic,
+            margin=getattr(variant, "margin", 0) if variant else 0,
+            order=getattr(variant, "order", "") if variant else "")
     else:
         kind_name = "yzslab"
         built = build_yzslab_padfree_call(stencil, local_shape, gshape, k,

@@ -70,7 +70,14 @@ class KernelVariant:
     depth-scaled default ladder).  ``family="stream"``: ``(bz, by)`` is
     the explicit strip geometry handed to the streaming builders'
     ``tiles=`` (validated through the same ``_stream_gates`` as the
-    picker).  Zero fields are "not overridden".
+    picker); ``margin`` overrides the kernel's sublane-rounded temporal
+    halo margin ``wm_a`` (a wider DMA-alignable y-flank — must be a
+    sublane multiple covering the k-step halo ``wm``); ``order``
+    permutes the strip-grid traversal (``"rev"`` walks the y strips
+    high-to-low, ``"xy"`` makes the x windows the outer grid axis —
+    x-windowed strips only).  Zero fields are "not overridden": a
+    variant with every constant zero compiles the byte-identical
+    default kernel.
     """
     id: str
     family: str            # "rdma" | "stream"
@@ -78,6 +85,8 @@ class KernelVariant:
     prefer_nc: int = 0
     bz: int = 0
     by: int = 0
+    margin: int = 0
+    order: str = ""
 
     @property
     def tiles(self) -> Optional[Tuple[int, int]]:
@@ -96,9 +105,16 @@ VARIANTS: Dict[str, KernelVariant] = {v.id: v for v in (
     KernelVariant(id="bz16y16", family="stream", bz=16, by=16),
     KernelVariant(id="bz8y8", family="stream", bz=8, by=8),
     KernelVariant(id="bz16y32", family="stream", bz=16, by=32),
+    # stream family, round 18: halo-margin widening (picker-chosen
+    # strips, wider DMA-alignable y-flank) and strip traversal order
+    KernelVariant(id="mg16", family="stream", margin=16),
+    KernelVariant(id="mg32", family="stream", margin=32),
+    KernelVariant(id="orev", family="stream", order="rev"),
+    KernelVariant(id="oxy", family="stream", order="xy"),
 )}
 
-STREAM_SWEEP: Tuple[str, ...] = ("bz16y16", "bz8y8", "bz16y32")
+STREAM_SWEEP: Tuple[str, ...] = ("bz16y16", "bz8y8", "bz16y32",
+                                 "mg16", "mg32", "orev", "oxy")
 RDMA_SWEEP: Tuple[str, ...] = ("ring3", "ring4", "nc8")
 
 
@@ -188,41 +204,69 @@ def validate_variant(v: KernelVariant, cfg: RunConfig,
     wm_a = -(-wm // sub) * sub
 
     if v.family == "stream":
-        bz, by = v.bz, v.by
-        if by % sub:
-            return False, (f"sublane-misaligned: by={by} is not a "
-                           f"multiple of the dtype's sublane tile "
-                           f"({sub} for itemsize {itemsize})")
-        if lz % bz:
-            return False, f"bz={bz} does not divide local Z={lz}"
-        if lz // bz < 3:
-            return False, (f"bz={bz} yields {lz // bz} z-chunks of "
-                           f"local Z={lz}; the stream needs >= 3")
-        if 2 * wm > bz:
-            return False, (f"bz={bz} cannot host the 2*wm={2 * wm} "
-                           f"k-step window")
-        if ly % by:
-            return False, f"by={by} does not divide local Y={ly}"
-        if not streamfused._by_valid(ly, by, wm_a, two_axis):
-            return False, (f"by={by} y-strip window does not fit local "
-                           f"Y={ly} (margin wm_a={wm_a}"
-                           + (", two-axis splice" if two_axis else "")
-                           + ")")
-        live = streamfused._strip_live_bytes(
-            bz, by, None, lx, wm, wm_a, max(itemsize, 4),
-            streamfused._MICRO[st.name][2], True, two_axis=two_axis,
-            Y=ly)
-        if live > streamfused._VMEM_LIMIT:
-            return False, (f"VMEM overflow: strip live set "
-                           f"{live} B > limit {streamfused._VMEM_LIMIT}"
-                           f" B for tiles ({bz}, {by})")
+        if v.order and v.order not in ("rev", "xy"):
+            return False, (f"unknown strip order {v.order!r} "
+                           f"(swept orders: rev, xy)")
+        wm_eff = wm_a
+        if v.margin:
+            if v.margin % sub:
+                return False, (f"sublane-misaligned: margin={v.margin} "
+                               f"is not a multiple of the dtype's "
+                               f"sublane tile ({sub} for itemsize "
+                               f"{itemsize})")
+            if v.margin < wm:
+                return False, (f"margin={v.margin} does not cover the "
+                               f"k-step temporal halo wm={wm}: the "
+                               f"window would treat roll-wrap garbage "
+                               f"as genuine data")
+            wm_eff = v.margin
+        if v.bz:
+            bz, by = v.bz, v.by
+            if by % sub:
+                return False, (f"sublane-misaligned: by={by} is not a "
+                               f"multiple of the dtype's sublane tile "
+                               f"({sub} for itemsize {itemsize})")
+            if lz % bz:
+                return False, f"bz={bz} does not divide local Z={lz}"
+            if lz // bz < 3:
+                return False, (f"bz={bz} yields {lz // bz} z-chunks of "
+                               f"local Z={lz}; the stream needs >= 3")
+            if 2 * wm > bz:
+                return False, (f"bz={bz} cannot host the 2*wm={2 * wm} "
+                               f"k-step window")
+            if ly % by:
+                return False, f"by={by} does not divide local Y={ly}"
+            if not streamfused._by_valid(ly, by, wm_eff, two_axis):
+                return False, (f"by={by} y-strip window does not fit "
+                               f"local Y={ly} (margin wm_a={wm_eff}"
+                               + (", two-axis splice" if two_axis
+                                  else "") + ")")
+            live = streamfused._strip_live_bytes(
+                bz, by, None, lx, wm, wm_eff, max(itemsize, 4),
+                streamfused._MICRO[st.name][2], True, two_axis=two_axis,
+                Y=ly)
+            if live > streamfused._VMEM_LIMIT:
+                return False, (f"VMEM overflow: strip live set "
+                               f"{live} B > limit "
+                               f"{streamfused._VMEM_LIMIT}"
+                               f" B for tiles ({bz}, {by})")
         # the authoritative gate set (the same function the builder
-        # runs) — anything the itemized checks above missed
-        if streamfused._stream_gates(st, lz, ly, lx, k, (bz, by),
-                                     sharded=True,
-                                     two_axis=two_axis) is None:
-            return False, (f"streaming tile gates reject ({bz}, {by}) "
-                           f"for local shape {local}")
+        # runs, margin threaded identically) — anything the itemized
+        # checks above missed, and the strip picker for margin/order
+        # variants that carry no explicit tiles
+        gates = streamfused._stream_gates(st, lz, ly, lx, k, v.tiles,
+                                          sharded=True,
+                                          two_axis=two_axis,
+                                          margin=v.margin)
+        if gates is None:
+            return False, (f"streaming gates reject variant {v.id} for "
+                           f"local shape {local}"
+                           + (f" at margin {v.margin}" if v.margin
+                              else ""))
+        if v.order == "xy" and gates[7] is None:
+            return False, ("order=xy permutes the (y, x) strip grid; "
+                           "this config's strips are whole-lane (1-d y "
+                           "grid) — nothing to reorder")
         return True, None
 
     if v.family == "rdma":

@@ -242,6 +242,16 @@ def candidates(cfg: RunConfig, backend: str,
     arbiter of its validity); enumerated candidates must pass
     :func:`_valid` after the locked fields are overlaid."""
     n_dev = int(n_devices) if n_devices else jax.device_count()
+    if cfg.groups:
+        # a coupled --groups run's execution strategy IS the group
+        # layout (the |grp:<sig> ledger identity): the monolithic mode
+        # enumeration does not describe it, and no mode field here can
+        # be adopted without changing which programs run where.  The
+        # requested config is the only candidate — a measured row for
+        # this exact split still ranks it (measured beats predicted),
+        # the decision is recorded, and perf_gate --policy-check
+        # replays it deterministically like any other.
+        return [cfg]
     halo = int(getattr(st, "halo", 1) or 1) if st is not None else 1
     ndim = len(cfg.grid)
     modes_list: List[Dict[str, Any]] = [
@@ -318,6 +328,12 @@ def _ledger_identity(c: RunConfig, backend: str) -> Tuple[str, str]:
 
 def _predict(c: RunConfig, st: Any, backend: str) -> Optional[float]:
     if st is None:
+        return None
+    if c.groups:
+        # the monolithic roofline does not describe a coupled round
+        # (per-group programs, interface traffic); without a measured
+        # |grp: row the decision is honestly "requested", never a
+        # prediction from the wrong model
         return None
     if c.fuse and backend != "tpu":
         return None  # Pallas temporal blocking does not run off-TPU

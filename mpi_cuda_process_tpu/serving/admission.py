@@ -53,6 +53,28 @@ class AdmissionController:
         from ..cli import _make_cfg_stencil
         from ..utils import budget
 
+        hbm = self.hbm_bytes
+        if hbm is None:
+            hbm = budget.device_hbm_bytes()
+        if build_cfg.groups:
+            # a coupled job is an admissible tenant: priced per group
+            # (worst group's devices are what the admission budget must
+            # cover), interface transients included — the same
+            # estimate_coupled_bytes the CLI's own guard uses
+            from ..parallel import groups as groups_lib
+
+            plans = groups_lib.plans_from_config(
+                build_cfg.groups, build_cfg.grid,
+                default_dtype=build_cfg.dtype or None)
+            worst, details = budget.estimate_coupled_bytes(plans)
+            worst_name, _, worst_parts = max(details, key=lambda d: d[1])
+            return {"total_bytes": int(worst),
+                    "parts": worst_parts,
+                    "coupled_groups": [
+                        {"group": name, "total_bytes": int(t)}
+                        for name, t, _ in details],
+                    "worst_group": worst_name,
+                    "hbm_bytes": int(hbm)}
         st = _make_cfg_stencil(build_cfg)
         total, parts = budget.estimate_run_bytes(
             st, build_cfg.grid, mesh=build_cfg.mesh, fuse=build_cfg.fuse,
@@ -61,9 +83,6 @@ class AdmissionController:
             overlap=build_cfg.overlap, pipeline=build_cfg.pipeline,
             exchange=build_cfg.exchange,
             ensemble_mesh=build_cfg.ensemble_mesh)
-        hbm = self.hbm_bytes
-        if hbm is None:
-            hbm = budget.device_hbm_bytes()
         return {"total_bytes": int(total), "parts": parts,
                 "hbm_bytes": int(hbm)}
 

@@ -431,6 +431,70 @@ def check_budget(
     return total, parts
 
 
+def estimate_coupled_bytes(plans) -> Tuple[int, list]:
+    """Per-device HBM estimate for a coupled ``--groups`` run.
+
+    Each group is priced as its own run (:func:`estimate_run_bytes` on
+    the group's stencil / local grid / sub-mesh — the group's interior
+    step IS the unmodified stepper, so its model applies verbatim),
+    plus the interface transients the coupling adds on that group's
+    devices: the ghost bands landed by ``device_put`` per round
+    (receiver side) and the staged resampled slices (sender side).
+    Interface transients are charged UNSHARDED per device — an upper
+    bound consistent with the coarse-but-conservative contract.
+
+    ``plans`` is a sequence of ``parallel.groups.GroupPlan``.  Returns
+    ``(worst_total, [(group_name, total, parts), ...])`` — the worst
+    group's devices are the ones the run OOMs on first.
+    """
+    from ..parallel import groups as groups_lib
+
+    traffic = groups_lib.interface_traffic(plans)
+    details = []
+    worst = 0
+    for g, p in enumerate(plans):
+        total, parts = estimate_run_bytes(p.stencil, p.grid,
+                                          mesh=p.mesh_shape)
+        extra: List[Tuple[str, int]] = []
+        if g < len(traffic):  # this group is the low side of interface g
+            t = traffic[g]
+            extra.append((f"interface {t['interface']}: staged send (up)",
+                          t["up"]["send_bytes"]))
+            extra.append((f"interface {t['interface']}: band recv (down)",
+                          t["down"]["recv_bytes"]))
+        if g > 0:  # ... and the high side of interface g-1
+            t = traffic[g - 1]
+            extra.append((f"interface {t['interface']}: band recv (up)",
+                          t["up"]["recv_bytes"]))
+            extra.append((f"interface {t['interface']}: staged send "
+                          "(down)", t["down"]["send_bytes"]))
+        parts = list(parts) + extra
+        total += sum(b for _, b in extra)
+        details.append((p.name, total, parts))
+        worst = max(worst, total)
+    return worst, details
+
+
+def check_coupled_budget(plans, hbm_bytes: Optional[int] = None
+                         ) -> Tuple[int, list]:
+    """The ``check_budget`` analogue for a coupled run: raise ValueError
+    with the worst group's arithmetic when any group cannot fit."""
+    hbm = hbm_bytes if hbm_bytes is not None else device_hbm_bytes()
+    worst, details = estimate_coupled_bytes(plans)
+    for name, total, parts in details:
+        if total > hbm:
+            raise ValueError(
+                f"--groups: group {name} needs ~{total / 2**30:.2f} GiB "
+                f"per device but HBM is {hbm / 2**30:.2f} GiB; refusing "
+                "before compile. Breakdown:\n"
+                + format_budget(total, parts, hbm)
+                + "\nLevers: a bf16 group dtype halves its state bytes; "
+                "a larger per-group :mesh shrinks its block; a smaller "
+                ":z fraction shrinks the hot region; --mem-check warn "
+                "overrides this guard.")
+    return worst, details
+
+
 def ring_vmem_bytes(slab_shape: Sequence[int], itemsize: int,
                     nslots: int, nchunks: int) -> int:
     """VMEM live bytes of one remote-DMA ring-exchange call under a

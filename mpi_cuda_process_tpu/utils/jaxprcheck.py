@@ -518,6 +518,90 @@ def assert_member_repack_structure(closed, plan, n_fields: int,
             "max_local_aval": max_local, "global_size": max_global}
 
 
+_COLLECTIVES = ("ppermute", "all_gather", "psum", "all_to_all",
+                "all_reduce")
+
+
+def assert_coupled_structure(step_jaxprs, transfer_jaxprs,
+                             sharded_groups: Sequence[int]):
+    """The MPMD coupling gate (``parallel/groups.py``): interface faces
+    are the ONLY cross-group communication.
+
+    Pins three promises:
+
+    1. **No group step replicates or reduces across anything**: zero
+       ``all_gather``/``all_to_all`` in every per-group step jaxpr.
+       ``ppermute`` (the intra-group halo exchange) is permitted ONLY
+       in groups listed in ``sharded_groups`` — a single-shard group's
+       step must be collective-free, so the coupling cannot smuggle a
+       degenerate collective in through an unsharded group.
+    2. **Intra-group exchange stays intra-group by construction**: a
+       sharded group's step must actually carry its ppermutes (a
+       sharded group with none didn't exchange at all) — and since
+       each group's mesh holds ONLY its own devices, those ppermutes
+       cannot name a cross-group peer.
+    3. **The interface transfers carry ZERO collectives** of any kind:
+       the band moves as slice -> resample -> cast on the sender plus
+       a host ``device_put`` — no collective CAN span two groups
+       (their meshes are disjoint), and this pins that none pretends
+       to.
+
+    Returns the per-group/per-transfer counts for the caller's report.
+    """
+    sharded = set(int(i) for i in sharded_groups)
+    group_pp = []
+    for g, closed in enumerate(step_jaxprs):
+        for prim in ("all_gather", "all_to_all"):
+            n = count_primitive(closed, prim)
+            assert n == 0, (
+                f"coupled group {g} step contains {n} {prim} eqn(s) — "
+                "a group step must never replicate state")
+        n_pp = count_primitive(closed, "ppermute")
+        if g in sharded:
+            assert n_pp > 0, (
+                f"coupled group {g} is sharded but its step carries no "
+                "ppermute — the group did not exchange its own halos")
+        else:
+            assert n_pp == 0, (
+                f"coupled group {g} is single-shard but its step "
+                f"carries {n_pp} ppermute eqn(s) — an unsharded group "
+                "step must be collective-free")
+        group_pp.append(n_pp)
+    transfer_counts = []
+    for t, closed in enumerate(transfer_jaxprs):
+        total = 0
+        for prim in _COLLECTIVES:
+            n = count_primitive(closed, prim)
+            assert n == 0, (
+                f"coupled interface transfer {t} contains {n} {prim} "
+                "eqn(s) — interface bands move by device_put only; no "
+                "collective may cross (or pretend to cross) groups")
+            total += n
+        transfer_counts.append(total)
+    return {"group_ppermute": group_pp,
+            "transfer_collectives": transfer_counts,
+            "n_groups": len(group_pp), "n_transfers": len(transfer_counts)}
+
+
+def check_coupled_structure(
+    groups: str = "heat3d@0-3,heat3d@4-7",
+    grid: Tuple[int, ...] = (30, 16, 16),
+) -> Dict[str, object]:
+    """Build a coupled runner on the current devices and run the full
+    coupling assertion set — the tier-1 smoke's jaxpr gate.  Builds
+    real (tiny) group states but never steps them."""
+    from ..parallel import groups as groups_lib
+
+    plans = groups_lib.plans_from_config(
+        groups, grid, n_devices=len(jax.devices()))
+    runner = groups_lib.CoupledRunner(plans)
+    report = assert_coupled_structure(
+        runner.step_jaxprs(), runner.transfer_jaxprs(),
+        runner.sharded_group_indices())
+    report["groups"] = [p.name for p in plans]
+    return report
+
+
 def check_pipeline_structure(
     stencil_name: str = "heat3d",
     grid: Tuple[int, int, int] = (32, 16, 128),

@@ -123,6 +123,8 @@ def _header_lines(status) -> list:
         extra.append(f"exchange={run['exchange']}")
     if run.get("kernel_variant"):
         extra.append(f"variant={run['kernel_variant']}")
+    if run.get("groups"):
+        extra.append(f"groups={run['groups']}")
     extra += flags
     if extra:
         lines.append("      " + "  ".join(extra))
@@ -222,6 +224,34 @@ def _fmtv(v):
     if isinstance(v, (int, float)):
         return f"{v:.6g}"
     return str(v)
+
+
+def _groups_lines(status) -> list:
+    """Coupled-run panel (parallel/groups.py): one row per device
+    group — op, resolution, dtype, devices, throughput, verdict —
+    already ranked worst verdict first by the metrics aggregator."""
+    groups = status.get("groups")
+    if not groups:
+        return []
+    worst = groups.get("worst_verdict")
+    head = (f"groups  {groups.get('n_groups', '?')} device groups "
+            f"coupled at interface faces"
+            + (f"  worst={worst}" if worst else ""))
+    rows = []
+    for r in groups.get("rows") or ():
+        ratio = r.get("ratio")
+        res = (f"fine x{ratio}" if isinstance(ratio, int) and ratio > 1
+               else "base")
+        mc = r.get("mcells_per_s")
+        gc = f"{mc / 1000:.4g}" if isinstance(mc, (int, float)) else "-"
+        devs = r.get("devices")
+        dev = ("-".join(map(str, devs)) if isinstance(devs, (list, tuple))
+               and len(devs) == 2 else "-")
+        rows.append([
+            r.get("group", "?"), r.get("op", "-"), res,
+            r.get("dtype", "-"), dev, gc, r.get("verdict") or "-"])
+    return [head, _table(rows, ["group", "op", "resolution", "dtype",
+                                "devices", "Gcells/s", "verdict"])]
 
 
 def _health_lines(status) -> list:
@@ -458,6 +488,7 @@ def run_frame(status, ledger_path) -> str:
     lines += _throughput_lines(status)
     lines += _health_lines(status)
     lines += _sim_health_lines(status)
+    lines += _groups_lines(status)
     lines += _scheduler_lines(status)
     lines += _fleet_lines(status)
     lines += _policy_lines(status)

@@ -597,6 +597,52 @@ print('router smoke ok: kill->rebalance->restart, %d done, 0 lost, '
 " || rc=1
 timeout -k 10 120 python scripts/obs_top.py /tmp/_t1_router/router-*.jsonl \
   --once > /dev/null || rc=1
+# Coupled device-group smoke (round 22, ISSUE 18): the MPMD engine end
+# to end on CPU — a 2-group heterogeneous run (fine wave3d + coarse
+# heat3d, coupled only at the interface faces) through the ordinary CLI
+# path, with (1) the jaxpr isolation gate (zero collectives in the
+# cross-group transfers, intra-group ppermutes only where a sub-mesh
+# shards), (2) per-group chunk telemetry + the resolved groups block in
+# a schema-valid manifest, and (3) the status payload carrying one row
+# per group.  The bit-exactness of same-physics splits is pinned by the
+# default-tier tests (tests/test_groups.py); this smoke pins the
+# end-to-end coupled loop every build.
+rm -f /tmp/_t1_groups.jsonl
+timeout -k 10 300 python -c "
+import json
+from cpuforce import force_cpu; force_cpu(8)
+from mpi_cuda_process_tpu import cli
+from mpi_cuda_process_tpu.obs.metrics import RunMetrics
+from mpi_cuda_process_tpu.utils import jaxprcheck
+gspec = 'wave3d:fine@0-3:z1/4:mesh1x4,heat3d:coarse@4-7:mesh1x4'
+rep = jaxprcheck.check_coupled_structure()  # 2-group same-physics gate
+assert rep['groups'] == ['g0:heat3d', 'g1:heat3d'], rep
+fields, mcells = cli.run(cli.config_from_args(
+    ['--stencil', 'wave3d', '--grid', '24,16,16', '--iters', '8',
+     '--groups', gspec, '--log-every', '2', '--health',
+     '--telemetry', '/tmp/_t1_groups.jsonl']))
+assert fields[0].shape == (24, 16, 16) and mcells > 0
+recs = [json.loads(l) for l in open('/tmp/_t1_groups.jsonl')
+        if l.strip()]
+rm = RunMetrics()
+for r in recs:
+    rm.ingest(r)
+man = next(r for r in recs if r.get('kind') == 'manifest')
+assert [g['group'] for g in man['groups']] \
+    == ['g0:wave3d', 'g1:heat3d'], man.get('groups')
+gc = {r['group'] for r in recs if r.get('kind') == 'group_chunk'}
+assert gc == {'g0:wave3d', 'g1:heat3d'}, gc
+st = rm.status()
+grp = st['groups']
+assert grp['n_groups'] == 2 and len(grp['rows']) == 2, grp
+assert grp['worst_verdict'] == 'HEALTHY', grp
+fin = next(r for r in recs if r.get('kind') == 'summary')
+assert fin['coupled'] is True and fin['n_groups'] == 2, fin
+print('groups smoke ok: 2 groups coupled, %.4f Mcells/s, rows=%s'
+      % (mcells, [r['group'] for r in grp['rows']]))
+" || rc=1
+timeout -k 10 120 python scripts/obs_report.py /tmp/_t1_groups.jsonl \
+  --check > /dev/null || rc=1
 # The committed campaign ledger must render in both one-command
 # summary surfaces: obs_report --ledger (best_known + quarantine
 # table) and the terminal monitor's ledger mode.

@@ -406,8 +406,11 @@ def test_candidates_extend_feasible_variants_only():
     locked = ps.locked_fields(_cfg())
     cands = ps.candidates(_cfg(), "cpu", locked, None, 2)
     vids = {c.kernel_variant for c in cands}
-    # z-only f32 on (96,32,128): bz16y32's y window does not fit
-    assert vids == {"", "bz16y16", "bz8y8"}
+    # z-only f32 on (96,32,128): bz16y32's y window does not fit, nor
+    # do the mg16/mg32 widened margins (by + 2*margin > Y for every
+    # tileable by); oxy has no x-windowed strip grid to permute; the
+    # traversal-order variant orev is geometry-free and stays feasible
+    assert vids == {"", "bz16y16", "bz8y8", "orev"}
     pinned = ps.candidates(_cfg(), "cpu",
                            locked | frozenset(["kernel_variant"]),
                            None, 2)
@@ -438,6 +441,34 @@ def _assert_variants_bit_exact(cfg, vids):
 
 def test_stream_variants_bit_exact_zonly_f32():
     _assert_variants_bit_exact(_cfg(), ("bz16y16", "bz8y8"))
+
+
+def test_margin_order_named_rejections():
+    """Round-18 sweep dims reject with named reasons, never compile."""
+    # mg16's widened flank cannot fit Y=32 (by + 2*16 > 32 for every by)
+    ok, why = autotune.validate_variant(autotune.VARIANTS["mg16"], _cfg())
+    assert not ok and "margin 16" in why
+    # oxy permutes a 2-d strip grid; whole-lane strips have none
+    ok, why = autotune.validate_variant(autotune.VARIANTS["oxy"], _cfg())
+    assert not ok and "order=xy" in why
+    # a sublane-misaligned margin is named before any geometry check
+    bad = autotune.KernelVariant(id="mg12", family="stream", margin=12)
+    ok, why = autotune.validate_variant(bad, _cfg())
+    assert not ok and "sublane-misaligned" in why
+    # an unknown order token is named
+    bad = autotune.KernelVariant(id="ozz", family="stream", order="zz")
+    ok, why = autotune.validate_variant(bad, _cfg())
+    assert not ok and "unknown strip order" in why
+
+
+@pytest.mark.slow
+def test_stream_margin_order_variants_bit_exact():
+    """The widened-margin and traversal-order constants change DMA
+    shapes and walk order only — fields stay bit-identical to the
+    default kernel through the full build path."""
+    _assert_variants_bit_exact(_cfg(), ("orev",))
+    _assert_variants_bit_exact(_cfg(grid=(96, 96, 128)),
+                               ("mg16", "mg32"))
 
 
 def test_rdma_variant_bit_exact_zonly_f32():
