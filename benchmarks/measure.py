@@ -349,25 +349,45 @@ def measure(name, grid, steps, dtype=None, compute="jnp", reps=3,
         # sub-mesh divides — sharding y keeps the groups' z rows whole
         h = n_dev // 2
         m0, m1 = f":mesh1x{h}", f":mesh1x{n_dev - h}"
+        transport = "device_put"
         if compute == "grp2":
             gspec = (f"{name}@0-{h - 1}{m0},"
                      f"{name}@{h}-{n_dev - 1}{m1}")
         elif compute == "grp2het":
             gspec = (f"{name}:fine@0-{h - 1}:z1/4{m0},"
                      f"heat3d:coarse@{h}-{n_dev - 1}{m1}")
+        elif compute == "grp2ici":
+            # round 23: the SAME equal split as grp2, bands moved as
+            # ppermute rounds over the union mesh — the A/B against the
+            # grp2 row prices exactly the transport swap
+            transport = "collective"
+            gspec = (f"{name}@0-{h - 1}{m0},"
+                     f"{name}@{h}-{n_dev - 1}{m1}")
+        elif compute == "grp2modes":
+            # round 23: per-group execution modes — group 0 routed
+            # through the overlap stepper, group 1 plain, same split as
+            # grp2 so the A/B prices the mode routing alone
+            gspec = (f"{name}@0-{h - 1}{m0}:overlap,"
+                     f"{name}@{h}-{n_dev - 1}{m1}")
         else:
             raise ValueError(f"unknown grp2 spec {compute!r}")
         plans = groups_lib.plans_from_config(
             gspec, grid, default_dtype=dtype or "float32",
             n_devices=n_dev)
-        runner = groups_lib.CoupledRunner(plans)
+        runner = groups_lib.CoupledRunner(plans, transport=transport)
         if getattr(runner, "n_groups", 1) < 2:
             raise ValueError(
                 "grp2 label built a monolithic runner (n_groups="
                 f"{getattr(runner, 'n_groups', 1)}) — must not price a "
                 "monolithic build under a group label")
+        if transport == "collective" and \
+                getattr(runner, "transport", "") != "collective":
+            raise ValueError(
+                "grp2ici label built the device_put transport — must "
+                "not price the host path under a collective label")
         rec = _time_coupled(runner, steps, reps)
         rec.setdefault("groups", gspec)
+        rec.setdefault("group_transport", transport)
         return rec
     elif compute.startswith("pipe"):
         # CROSS-PASS pipelined sharded temporal blocking: overlap split
@@ -958,6 +978,21 @@ CONFIGS = [
      "grp2"),
     ("wave3d_512_f32_grp2het", "wave3d", (512, 512, 512), 8, "float32",
      "grp2het"),
+    # ── Tier D15: fast coupled groups (round 23).  *_grp2ici = the same
+    # equal split moved over the COLLECTIVE interface transport (one
+    # ppermute round per interface per direction inside a union-mesh
+    # shard_map — zero host hops, gated by jaxprcheck): the A/B against
+    # the *_grp2 row prices exactly the transport swap.  The ledger keys
+    # these rows |gtx:collective so neither transport can baseline the
+    # other.  *_grp2modes = per-group execution modes (group 0 overlap,
+    # group 1 plain) under the default transport: the A/B against *_grp2
+    # prices per-group mode routing alone.
+    ("heat3d_512_f32_grp2ici", "heat3d", (512, 512, 512), 10, "float32",
+     "grp2ici"),
+    ("wave3d_512_f32_grp2ici", "wave3d", (512, 512, 512), 8, "float32",
+     "grp2ici"),
+    ("heat3d_512_f32_grp2modes", "heat3d", (512, 512, 512), 10,
+     "float32", "grp2modes"),
 ]
 
 # Tier-D labels: new large Mosaic compiles.  A hang here is plausibly a
@@ -994,7 +1029,12 @@ _RISKY = frozenset(
 # *_grp2 labels exist, the streaming builders accept the round-18
 # margin/order sweep constants, and the sharded stepper is now also
 # constructed per-group over device subsets, so older declines retry.
-BUILDER_REV = 12
+# rev 13: fast coupled groups (round 23) — new *_grp2ici/*_grp2modes
+# labels exist, the coupled engine grew the collective interface
+# transport (union-mesh ppermute wire) and per-group mode routing
+# through the fused/stream/overlap/pipeline steppers, so older coupled
+# declines retry.
+BUILDER_REV = 13
 
 
 def _skip_cached(cached):

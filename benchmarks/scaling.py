@@ -189,7 +189,8 @@ def bench_config(st, mesh_shape, global_shape, steps, reps=3, overlap=False,
             kernel_kind)
 
 
-def bench_groups(name, n_dev, n_groups, global_shape, steps, reps=3):
+def bench_groups(name, n_dev, n_groups, global_shape, steps, reps=3,
+                 transport="device_put"):
     """Coupled device-group rung (--groups): N same-physics groups.
 
     The rung's devices split into N contiguous equal groups, each on a
@@ -214,12 +215,15 @@ def bench_groups(name, n_dev, n_groups, global_shape, steps, reps=3):
     try:
         plans = groups_lib.plans_from_config(gspec, global_shape,
                                              n_devices=n_dev)
-        runner = groups_lib.CoupledRunner(plans)
+        runner = groups_lib.CoupledRunner(plans, transport=transport)
     except ValueError:
-        # structural decline (z share / y sharding indivisible)
+        # structural decline (z share / y sharding indivisible, or a
+        # geometry the collective wire rejects by name)
         return None
     if getattr(runner, "n_groups", 1) != n_groups:
         return None  # must not price a different split under this rung
+    if getattr(runner, "transport", "device_put") != transport:
+        return None  # must not price one transport under the other's row
 
     def rounds(n):
         for fs in runner.fields:
@@ -385,6 +389,22 @@ def main(argv=None) -> int:
                         "stamps the groups spec, so coupled rows are "
                         "never confused with monolithic rows (the "
                         "ledger keys them apart |grp:<sig>)")
+    p.add_argument("--group-transport", default="device_put",
+                   choices=["device_put", "collective"],
+                   help="interface-band transport for the --groups "
+                        "rungs (round 23, parallel/groups.py): "
+                        "device_put (default, host-mediated receiver-"
+                        "side band landing) or collective — raw sender "
+                        "rows as one ppermute round per interface per "
+                        "direction inside a union-mesh shard_map, "
+                        "resampled shard-local on the receiver (zero "
+                        "host hops; jaxpr-gated by utils/jaxprcheck)."
+                        "  The A/B against the same --groups ladder "
+                        "under device_put prices exactly the transport "
+                        "swap; every emitted row stamps the transport, "
+                        "and the ledger keys collective rows apart "
+                        "(|gtx:collective), so neither transport can "
+                        "baseline the other.  Needs --groups")
     p.add_argument("--telemetry", default=None, metavar="PATH",
                    help="write a JSONL telemetry event log (obs/ "
                         "schema, same manifest as cli --telemetry): "
@@ -427,6 +447,9 @@ def main(argv=None) -> int:
                     "rungs run each group's plain sharded stepper, so "
                     "the A/B against the monolithic ladder prices the "
                     "coupling and nothing else")
+    if a.group_transport != "device_put" and not a.groups:
+        p.error("--group-transport prices the coupled interface "
+                "transport; it needs --groups N")
     if a.pipeline:
         if not (a.fuse > 1):
             p.error("--pipeline needs --fuse K (the slab-carry scan "
@@ -540,15 +563,17 @@ def _ladder(a, p, jax, st, n_devices, _tel) -> int:
         gspec = None
         if a.groups:
             got = bench_groups(a.stencil, n_dev, a.groups, global_shape,
-                               a.steps, a.reps)
+                               a.steps, a.reps,
+                               transport=a.group_transport)
             if got is None:
                 print(f"[scaling] skip {mesh_shape}: {n_dev} device(s) "
-                      f"cannot host {a.groups} coupled groups",
-                      file=sys.stderr)
+                      f"cannot host {a.groups} coupled groups "
+                      f"({a.group_transport})", file=sys.stderr)
                 _tel("skip", mesh=list(mesh_shape),
                      grid=list(global_shape), groups=a.groups,
+                     group_transport=a.group_transport,
                      reason="device count or geometry cannot host the "
-                            "coupled group split")
+                            "coupled group split under this transport")
                 continue
             mcells, per_step, gspec = got
             kernel_kind = None
@@ -590,6 +615,7 @@ def _ladder(a, p, jax, st, n_devices, _tel) -> int:
             "mesh_axes": a.mesh_axes,
             "n_groups": a.groups,
             "groups": gspec,
+            "group_transport": a.group_transport if a.groups else None,
             "mesh": list(mesh_shape), "grid": list(global_shape),
             "mcells_per_s": round(mcells, 1),
             "mcells_per_s_per_device": round(per_dev, 1),

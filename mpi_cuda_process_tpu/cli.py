@@ -66,16 +66,36 @@ def build_parser() -> argparse.ArgumentParser:
                         "quarter of z on devices 0-3 inside a coarse "
                         "heat3d far-field on devices 4-7.  Clause "
                         "grammar: <op>[:fine[R]|:coarse][:<dtype>]@"
-                        "<d0>-<d1>[:z<num>/<den>][:mesh<m0>x<m1>...].  "
-                        "Each group's interior step is the unmodified "
-                        "sharded stepper on its sub-mesh; the ghost-band "
-                        "interface refresh is the only cross-group "
-                        "traffic (jaxprcheck.assert_coupled_structure "
-                        "pins it).  A 2-group same-physics split is "
-                        "bit-exact vs the monolithic run.  Excludes the "
-                        "monolithic mode flags (--mesh/--fuse/--ensemble"
-                        "/--overlap/--pipeline/...): per-group behavior "
-                        "lives in the clauses")
+                        "<d0>-<d1>[:z<num>/<den>][:mesh<m0>x<m1>...]"
+                        "[:<mode>+<mode>...].  Each group's interior "
+                        "step runs on its own sub-mesh; a trailing "
+                        "'+'-joined mode token (fuse<K>/stream/padfree/"
+                        "overlap/pipeline/plain, e.g. "
+                        ":fuse2+stream+overlap) routes it through the "
+                        "matching fused/overlapped stepper UNMODIFIED "
+                        "(fuse<K> must agree across groups; 'plain' "
+                        "locks the default; no token = unset, "
+                        "--auto-policy may resolve it per group).  The "
+                        "ghost-band interface refresh is the only "
+                        "cross-group traffic (jaxprcheck."
+                        "assert_coupled_structure pins it).  A 2-group "
+                        "same-physics split is bit-exact vs the "
+                        "monolithic run under every legal mode combo.  "
+                        "Excludes the monolithic mode flags (--mesh/"
+                        "--fuse/--ensemble/--overlap/--pipeline/...): "
+                        "per-group behavior lives in the clauses")
+    p.add_argument("--group-transport", default="device_put",
+                   choices=["device_put", "collective"],
+                   help="interface transport for --groups: device_put "
+                        "(host-ordered buffer moves between the group "
+                        "meshes — correct on any backend) | collective "
+                        "(one union-mesh shard_map whose per-interface "
+                        "ppermutes move the raw edge rows chip to chip; "
+                        "resample/cast shard-local on the receiver — "
+                        "bit-identical to device_put, zero host hops, "
+                        "jaxprcheck.assert_group_transport_structure "
+                        "pins exactly 2 ppermutes per interface and "
+                        "zero device_put)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--density", type=float, default=0.15,
                    help="alive probability for random init (reference: 0.15)")
@@ -444,7 +464,8 @@ def config_from_args(argv=None) -> RunConfig:
     a = build_parser().parse_args(argv)
     return RunConfig(
         stencil=a.stencil, grid=a.grid, iters=a.iters, dtype=a.dtype,
-        mesh=a.mesh, groups=a.groups, seed=a.seed, density=a.density,
+        mesh=a.mesh, groups=a.groups, group_transport=a.group_transport,
+        seed=a.seed, density=a.density,
         init=a.init,
         periodic=a.periodic, log_every=a.log_every,
         checkpoint_every=a.checkpoint_every, checkpoint_dir=a.checkpoint_dir,
@@ -892,8 +913,17 @@ def build(cfg: RunConfig):
                                          batch=cfg.ensemble or 1)
             else:
                 padfree = cfg.fuse_kind == "padfree"
-            fused = make_fused_step(st, cfg.grid, cfg.fuse,
+            # tiled-family variants (policy/autotune.py round 23) carry
+            # explicit window tiles for the padded kernel; resolve_variant
+            # already pinned fuse_kind == "tiled" (so padfree is False)
+            # and pre-validated the geometry through _tiles_valid
+            tiles = (variant.tiles if variant is not None
+                     and variant.family == "tiled" else None)
+            fused = make_fused_step(st, cfg.grid, cfg.fuse, tiles=tiles,
                                     periodic=cfg.periodic, padfree=padfree)
+            if fused is not None and tiles is not None:
+                # same introspection tag the sharded steppers carry
+                fused._kernel_variant = variant.id
             if fused is None and padfree and cfg.fuse_kind == "auto":
                 # pad-free untileable (VMEM window gate): padded fallback
                 fused = make_fused_step(st, cfg.grid, cfg.fuse,
@@ -1193,7 +1223,8 @@ def _open_telemetry(cfg: RunConfig):
             from .parallel import groups as groups_lib
 
             extra["groups"] = [
-                p.describe() for p in groups_lib.plans_from_config(
+                dict(p.describe(), transport=cfg.group_transport)
+                for p in groups_lib.plans_from_config(
                     cfg.groups, cfg.grid,
                     default_dtype=cfg.dtype or None)]
         except Exception:  # noqa: BLE001 — see above
@@ -1258,7 +1289,13 @@ def _run_once(cfg: RunConfig, decision=None) -> Tuple:
         if decision is not None:
             # the decision and its provenance become part of the run's
             # manifest trail — perf_gate --policy-check replays exactly
-            # this event against the current ledger
+            # this event against the current ledger.  A coupled
+            # resolution additionally records one policy_group event
+            # per group FIRST (obs_report/metrics read them by group
+            # name), then the main event whose group_decisions list is
+            # what the policy check replays.
+            for gd in getattr(decision, "group_decisions", None) or []:
+                session.event("policy_group", **gd)
             session.event("policy", **decision.as_event())
         return _run_measured(cfg, session, decision=decision)
     except cancellation.RunCancelled as e:
@@ -1312,7 +1349,8 @@ def _check_coupled_mem_budget(cfg: RunConfig, plans) -> None:
     from .utils import budget
 
     try:
-        worst, _ = budget.check_coupled_budget(plans)
+        worst, _ = budget.check_coupled_budget(
+            plans, transport=cfg.group_transport)
     except ValueError:
         if cfg.mem_check == "error":
             raise
@@ -1350,7 +1388,8 @@ def _run_coupled(cfg: RunConfig, session, decision=None) -> Tuple:
     enable_compile_cache(cfg.compile_cache)
     mesh_lib.bootstrap_distributed()
     runner = groups_lib.CoupledRunner(
-        plans, seed=cfg.seed, density=cfg.density, init_kind=cfg.init)
+        plans, seed=cfg.seed, density=cfg.density, init_kind=cfg.init,
+        transport=cfg.group_transport)
 
     start_round = 0
     if cfg.resume and cfg.checkpoint_dir and os.path.isdir(
@@ -1362,7 +1401,8 @@ def _run_coupled(cfg: RunConfig, session, decision=None) -> Tuple:
         try:
             from .obs import costmodel
 
-            session.event("costmodel", **costmodel.coupled_cost(plans))
+            session.event("costmodel", **costmodel.coupled_cost(
+                plans, transport=cfg.group_transport))
         except Exception:  # noqa: BLE001 — telemetry never load-bearing
             log.debug("coupled cost model failed; trace goes without it",
                       exc_info=True)
@@ -1498,6 +1538,11 @@ def _run_coupled(cfg: RunConfig, session, decision=None) -> Tuple:
 def _run_measured(cfg: RunConfig, session, decision=None) -> Tuple:
     if cfg.groups:
         return _run_coupled(cfg, session, decision=decision)
+    if cfg.group_transport not in ("", "device_put"):
+        raise ValueError(
+            "--group-transport selects the --groups interface "
+            "transport; a monolithic run has no interfaces to move — "
+            "drop the flag or pass --groups")
     if cfg.debug_checks and cfg.fuse:
         raise ValueError("--debug-checks excludes --fuse (the fused "
                          "kernel replaces the step being instrumented)")

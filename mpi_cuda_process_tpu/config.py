@@ -71,6 +71,16 @@ class RunConfig:
     # "wave3d:fine@0-3:z1/4,heat3d:coarse@4-7".  "" = monolithic SPMD.
     # SIM field: the group layout picks the compiled programs.
     groups: str = ""
+    # interface transport for --groups (parallel/groups.py round 23):
+    # device_put (host-ordered buffer moves between group meshes —
+    # correct on any backend) | collective (one union-mesh shard_map
+    # whose per-interface ppermutes carry the raw edge rows chip to
+    # chip; resample/cast shard-local on the receiver, bit-identical
+    # to device_put).  SIM field: it picks the compiled exchange
+    # programs (the computed trajectory is identical by the pinned
+    # transport-equivalence invariant, but identity stays honest —
+    # the ledger prices the two transports apart via |gtx:).
+    group_transport: str = "device_put"
     # measurement-driven execution policy (policy/select.py): resolve
     # every mode flag NOT explicitly passed (--mesh/--ensemble-mesh/
     # --fuse/--fuse-kind/--overlap/--pipeline/--exchange) from the

@@ -517,37 +517,51 @@ def static_cost(
 
 
 def coupled_cost(plans, hbm_gbs: float = V5E_HBM_GBS,
-                 ici_gbs: float = V5E_ICI_GBS) -> Dict[str, Any]:
+                 ici_gbs: float = V5E_ICI_GBS,
+                 transport: str = "") -> Dict[str, Any]:
     """The coupled (``--groups``) run's static cost block.
 
     Per-group :func:`static_cost` (each group's interior step is the
-    unmodified stepper on its own sub-mesh, so the monolithic model
-    applies verbatim per group) plus an EXPLICIT interface sub-block:
-    the cross-group band refresh is the only new traffic, and it is
-    priced by name — rounds per step, bytes per direction, ratios and
-    dtypes per interface — so obs_report can attribute the coupling
-    cost separately from each group's own exchange.  The budget
-    cross-check: ``interface.bytes_per_round`` must equal the sum of
-    ``utils/budget.py``'s per-group interface recv parts (tests pin
-    it), so the cost model and the HBM budget cannot drift apart.
+    unmodified stepper on its own sub-mesh — round 23: its clause mode
+    tokens flow into ``fuse``/``fuse_kind``, so a fused/stream group is
+    priced exactly like the monolithic run it mirrors) plus an EXPLICIT
+    interface sub-block: the cross-group band refresh is the only new
+    traffic, and it is priced by name — rounds per step, bytes per
+    direction, ratios and dtypes per interface — so obs_report can
+    attribute the coupling cost separately from each group's own
+    exchange.  ``transport`` prices the two band paths apart:
+    ``device_put`` moves the RECEIVER-side resampled band
+    (``bytes_per_round`` = sum of recv parts), ``collective`` moves the
+    RAW sender rows over ICI and resamples on the receiver
+    (``bytes_per_round`` = sum of send parts — the wire's actual
+    payload).  The budget cross-check: ``interface.bytes_per_round``
+    must equal the sum of ``utils/budget.py``'s matching per-group
+    interface parts (tests pin it), so the cost model and the HBM
+    budget cannot drift apart.
     """
     from ..parallel import groups as groups_lib
 
+    transport = transport or groups_lib.TRANSPORT_BACKEND
     group_costs = []
     for p in plans:
+        s = p.spec
         c = static_cost(p.stencil, p.grid, mesh=p.mesh_shape,
+                        fuse=s.fuse_k if s.fuse_k > 1 else 0,
+                        fuse_kind=s.kind or "auto",
                         hbm_gbs=hbm_gbs, ici_gbs=ici_gbs)
         c["group"] = p.name
         c["ratio"] = p.ratio
         c["devices"] = [p.spec.dev_lo, p.spec.dev_hi]
         c["cells_per_round"] = p.cells
         c["owned_cells"] = p.owned_cells
+        c["modes"] = list(s.modes)
         group_costs.append(c)
     traffic = groups_lib.interface_traffic(plans)
     recv_bytes = sum(t[d]["recv_bytes"] for t in traffic
                      for d in ("up", "down"))
     send_bytes = sum(t[d]["send_bytes"] for t in traffic
                      for d in ("up", "down"))
+    wire_bytes = send_bytes if transport == "collective" else recv_bytes
     return {
         "coupled": True,
         "n_groups": len(plans),
@@ -558,11 +572,11 @@ def coupled_cost(plans, hbm_gbs: float = V5E_HBM_GBS,
             # one wholesale band refresh per coupled round — the whole
             # coupling protocol, by construction
             "rounds_per_step": 1,
-            "transport": groups_lib.TRANSPORT_BACKEND,
-            "bytes_per_round": int(recv_bytes),
+            "transport": transport,
+            "bytes_per_round": int(wire_bytes),
             "staged_bytes_per_round": int(send_bytes),
             "predicted_ms_per_round": round(
-                recv_bytes / (ici_gbs * 1e9) * 1e3, 6),
+                wire_bytes / (ici_gbs * 1e9) * 1e3, 6),
             "interfaces": traffic,
         },
     }

@@ -125,6 +125,16 @@ def baseline_key(row: Dict[str, Any]) -> str:
         # with a DIFFERENT split) — across group signatures the gate
         # reports NO_BASELINE, not REGRESSED
         tail += f"|grp:{grp}"
+    gtx = flags.get("group_transport")
+    if gtx:
+        # the INTERFACE TRANSPORT (round 23): a collective-transport
+        # coupled row moves its ghost bands over ICI ppermute rounds, a
+        # device_put row over host-mediated transfers — different
+        # execution paths, so one must never baseline the other; across
+        # transports the gate reports NO_BASELINE.  Rides the flags
+        # only when non-default (device_put), so every pre-existing
+        # coupled row keeps its historical baseline key byte-for-byte.
+        tail += f"|gtx:{gtx}"
     return f"{k['label']}|{k.get('backend')}{tail}"
 
 
@@ -331,6 +341,11 @@ def _flags(run: Dict[str, Any]) -> Dict[str, Any]:
         from ..config import groups_signature
 
         out["groups_sig"] = groups_signature(run["groups"])
+        if run.get("group_transport") and \
+                run["group_transport"] != "device_put":
+            # non-default interface transport (round 23): part of the
+            # identity AND the |gtx: baseline-key tail
+            out["group_transport"] = run["group_transport"]
     return out
 
 
@@ -361,6 +376,82 @@ def _cli_label(run: Dict[str, Any]) -> str:
         n = len([c for c in str(run["groups"]).split(",") if c.strip()])
         parts.append(f"grp{n}")
     return "cli_" + "_".join(p for p in parts if p)
+
+
+def group_label(op: Any) -> str:
+    """The per-group ledger row label (round 23): the op alone.
+
+    Deliberately minimal — the clause signature in the flags
+    (``groups_sig`` of the single clause's canonical form, with its
+    mode tokens folded in) carries the full identity into the baseline
+    key, so two clauses differing in ANYTHING (devices, z fraction,
+    sub-mesh, dtype, ratio, modes) never share a baseline.  The policy
+    resolver (policy/select.py) builds the same label + flags for its
+    per-group candidates, so a measured row matches if and only if
+    this exact clause was actually run.
+    """
+    return f"cli_grp_{op}"
+
+
+def group_flags(clause: str, transport: Optional[str] = None
+                ) -> Dict[str, Any]:
+    """The per-group ledger row flags for one canonical clause."""
+    from ..config import groups_signature
+
+    out: Dict[str, Any] = {"groups_sig": groups_signature(clause)}
+    if transport and transport != "device_put":
+        out["group_transport"] = transport
+    return out
+
+
+def _group_rows(manifest: Dict[str, Any], events: List[Dict[str, Any]],
+                run: Dict[str, Any], prov: Dict[str, Any], source: str,
+                hb: Optional[str], health: Optional[str]
+                ) -> List[Dict[str, Any]]:
+    """Per-group rows for one coupled cli log (round 23).
+
+    One row per group, valued at the group's wall-weighted mean
+    Mcells/s over its ``group_chunk`` events — the per-group measured
+    table ``--auto-policy --groups`` resolves each group's mode tokens
+    against.  Needs the manifest ``groups`` block's ``clause`` entry
+    (older logs without it, or runs that died before any chunk, add
+    nothing — the main coupled row still lands as before).
+    """
+    rows: List[Dict[str, Any]] = []
+    transport = run.get("group_transport") or None
+    for meta in manifest.get("groups") or []:
+        if not isinstance(meta, dict) or not meta.get("clause"):
+            continue
+        name = meta.get("group")
+        wall = 0.0
+        weighted = 0.0
+        last_t = None
+        for e in events:
+            if e.get("kind") != "group_chunk" or e.get("group") != name:
+                continue
+            w = e.get("wall_s")
+            v = e.get("mcells_per_s")
+            if not isinstance(w, (int, float)) or w <= 0 or \
+                    not isinstance(v, (int, float)):
+                continue
+            wall += w
+            weighted += v * w
+            if e.get("t") is not None:
+                last_t = e["t"]
+        if wall <= 0:
+            continue
+        rows.append(make_row(
+            group_label(meta.get("op")), round(weighted / wall, 3),
+            source=source, measured_at=last_t, heartbeat=hb,
+            health=health, expected_backend=prov.get("backend"),
+            provenance=_prov_subset(prov),
+            grid=meta.get("grid"), mesh=meta.get("mesh") or None,
+            dtype=meta.get("dtype"),
+            flags=group_flags(meta["clause"], transport),
+            builder_rev=prov.get("builder_rev"),
+            detail={"group": name, "clause": meta["clause"],
+                    "modes": list(meta.get("modes") or [])}))
+    return rows
 
 
 def _scaling_label(run: Dict[str, Any], rung: Dict[str, Any]) -> str:
@@ -487,6 +578,11 @@ def rows_from_log(log_path: str) -> List[Dict[str, Any]]:
             cancelled_ev = e
     if tool == "cli":
         summaries = [e for e in events if e.get("kind") == "summary"]
+        if run.get("groups"):
+            # per-group rows land ALONGSIDE the coupled headline row —
+            # the policy resolver reads these, the perf gate the main
+            rows.extend(_group_rows(manifest, events, run, prov,
+                                    source, hb, health))
         for s in summaries:
             rows.append(make_row(
                 _cli_label(run), s.get("mcells_per_s"), source=source,

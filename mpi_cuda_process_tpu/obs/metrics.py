@@ -451,9 +451,13 @@ class RunMetrics:
             # the panel shows every group's identity before the first
             # group_chunk lands
             if isinstance(g, dict) and isinstance(g.get("group"), str):
+                # round 23: mode tokens + interface transport ride the
+                # manifest block, so the panel names each group's
+                # execution path, not just its physics
                 self.groups.setdefault(g["group"], {}).update(
                     {k: g.get(k) for k in ("op", "ratio", "dtype",
-                                           "devices", "grid")
+                                           "devices", "grid", "modes",
+                                           "transport")
                      if g.get(k) is not None})
         self.registry.info(
             "obs_run_info", "identity of the (primary) run").set(

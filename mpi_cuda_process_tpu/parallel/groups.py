@@ -41,6 +41,34 @@ gracefully from there.
 Resampling is exact where it can be: restriction is iterated pairwise
 averaging (power-of-two ratios only, rejected otherwise by name), so
 ``restrict(interpolate(x)) == x`` bitwise — the conservation pin.
+
+Round 23 closes the two performance residues of the round-22 engine:
+
+* **Collective interface transport** (``transport="collective"``, CLI
+  ``--group-transport collective``): instead of host-ordered
+  ``device_put`` hops, the interface bands move as ``lax.ppermute``
+  rounds inside a single ``shard_map`` over the UNION device set — the
+  sender group's edge shards send their RAW owned rows straight to the
+  receiver group's edge shards (one ppermute per interface per
+  direction, exactly ``2 * n_interfaces`` in the transport jaxpr), and
+  resampling + dtype cast happen SHARD-LOCALLY on the receive side in
+  the sender's dtype, the same op order as the ``device_put`` path —
+  so the two transports are bit-identical.  Zero ``device_put`` in the
+  coupled step (``jaxprcheck.assert_group_transport_structure`` pins
+  both counts); the only host work left is a zero-copy rewrap of
+  per-device buffers between the group meshes and the union mesh
+  (``jax.make_array_from_single_device_arrays``).  Requires matching
+  y-shard counts across each interface — rejected by name, never a
+  silent fallback.
+
+* **Per-group execution modes**: each clause may carry a trailing
+  ``+``-joined mode token (``wave3d@0-3:mesh1x4:stream+overlap``) so
+  the group's sub-mesh runs the existing fused/stream/overlap/pipeline
+  steppers UNMODIFIED (``stepper.make_sharded_temporal_step``).  A
+  ``fuseK`` token advances K micro-steps per coupled round, so the
+  ghost band widens to ``K * halo * phases`` and every group must
+  share the same K (rejected by name).  A forced mode the builder
+  declines raises — forced flags never fall back silently.
 """
 
 from __future__ import annotations
@@ -54,19 +82,31 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..config import groups_signature
-from ..driver import make_runner
+from ..driver import make_runner, pipeline_hooks
 from ..ops.stencil import Fields, Stencil, make_stencil
 from ..utils.init import init_state
 from . import mesh as mesh_lib
 from . import stepper as stepper_lib
 
-# The cross-group transport.  Groups run under DIFFERENT meshes on
-# disjoint devices, so no named-axis collective can carry the band;
-# the honest backend tag for what actually moves the bytes.
+# The DEFAULT cross-group transport.  Groups run under DIFFERENT meshes
+# on disjoint devices, so no named-axis collective of either group can
+# carry the band; the honest backend tag for what actually moves the
+# bytes.  ``"collective"`` instead builds ONE shard_map over the union
+# device set whose per-interface ppermutes carry the raw rows edge
+# shard to edge shard — never a host hop.
 TRANSPORT_BACKEND = "device_put"
+TRANSPORTS = ("device_put", "collective")
+
+# Per-group mode tokens (the trailing +-joined clause qualifier) and
+# the combinations auto-policy may propose for an unset group: k stays
+# 1 in every proposed candidate because the fuse factor must be
+# uniform across groups, so it cannot be resolved per group
+# independently — fuseK/padfree/pipeline ride explicit user tokens.
+MODE_WORDS = ("plain", "stream", "padfree", "overlap", "pipeline")
+MODE_CANDIDATES = ((), ("stream",), ("stream", "overlap"))
 
 _DTYPE_ALIASES = {
     "f32": "float32", "float32": "float32",
@@ -74,6 +114,66 @@ _DTYPE_ALIASES = {
     "f16": "float16", "float16": "float16",
     "f64": "float64", "float64": "float64",
 }
+_DTYPE_SHORT = {"float32": "f32", "bfloat16": "bf16", "float16": "f16",
+                "float64": "f64"}
+
+_MODE_ORDER = ("fuse", "stream", "padfree", "overlap", "pipeline", "plain")
+
+
+def _canon_modes(modes: Tuple[str, ...]) -> Tuple[str, ...]:
+    """Mode tokens in the one canonical order (``fuseK`` first)."""
+    def rank(t: str) -> int:
+        return _MODE_ORDER.index("fuse" if t.startswith("fuse") else t)
+    return tuple(sorted(modes, key=rank))
+
+
+def _parse_modes(tok: str, clause: str) -> Tuple[str, ...]:
+    """Parse one ``+``-joined mode token; every rejection is named.
+
+    Returns the canonical mode tuple, or raises ``ValueError`` —
+    ``None`` is never returned: the caller has already decided this
+    token is not a dtype/z/mesh qualifier.
+    """
+    words = tok.split("+")
+    modes: List[str] = []
+    for w in words:
+        if w.startswith("fuse") and w != "fuse":
+            try:
+                k = int(w[4:])
+            except ValueError:
+                raise ValueError(
+                    f"--groups clause {clause!r}: bad fuse token {w!r} "
+                    "(expected fuse<K> with integer K >= 2)") from None
+            if k < 2:
+                raise ValueError(
+                    f"--groups clause {clause!r}: fuse{k} needs K >= 2 "
+                    "(fuse1 is the plain stepper — drop the token)")
+        elif w not in MODE_WORDS:
+            raise ValueError(
+                f"--groups clause {clause!r}: unknown mode word {w!r} "
+                f"(expected fuse<K> or one of {list(MODE_WORDS)})")
+        if w in modes or (w.startswith("fuse")
+                          and any(m.startswith("fuse") for m in modes)):
+            raise ValueError(
+                f"--groups clause {clause!r}: duplicate mode word {w!r}")
+        modes.append(w)
+    if "stream" in modes and "padfree" in modes:
+        raise ValueError(
+            f"--groups clause {clause!r}: stream and padfree are "
+            "mutually exclusive kernel kinds")
+    if "plain" in modes and len(modes) > 1:
+        raise ValueError(
+            f"--groups clause {clause!r}: 'plain' locks the default "
+            "stepper and cannot combine with other mode words")
+    out = _canon_modes(tuple(modes))
+    if "pipeline" in out:
+        if not any(m.startswith("fuse") for m in out) \
+                or not ({"stream", "padfree"} & set(out)):
+            raise ValueError(
+                f"--groups clause {clause!r}: pipeline needs fuse<K> "
+                "and a slab-operand kind (stream or padfree) — the "
+                "same contract as the monolithic --pipeline")
+    return out
 
 _GROUP_RE = re.compile(
     r"^(?P<head>[^@]+)@(?P<d0>\d+)(?:-(?P<d1>\d+))?(?P<tail>(?::[^:,]+)*)$")
@@ -90,11 +190,20 @@ class GroupSpec:
 
     Grammar (comma-separated, one clause per group)::
 
-        <op>[:fine[R]|:coarse][:<dtype>]@<d0>[-<d1>][:z<num>/<den>][:mesh<m0>x<m1>...]
+        <op>[:fine[R]|:coarse][:<dtype>]@<d0>[-<d1>][:z<num>/<den>]
+            [:mesh<m0>x<m1>...][:<mode>+<mode>...]
 
     e.g. ``"wave3d:fine@0-3:z1/4,heat3d:coarse@4-7"``: a 2x-refined
     wave3d hot region over the first quarter of the z axis on devices
     0-3, and a base-resolution heat3d far-field on devices 4-7.
+
+    The trailing mode token selects the group's EXECUTION MODE on its
+    own sub-mesh (round 23): ``fuseK`` (K micro-steps per coupled
+    round, uniform across groups), ``stream``/``padfree`` (the fused
+    kernel kinds), ``overlap``, ``pipeline``, joined with ``+``
+    (``:fuse2+stream+overlap``).  ``plain`` locks the default stepper
+    EXPLICITLY — a clause with no mode token is *unset* and
+    ``--auto-policy`` may resolve it per group.
     """
 
     op: str
@@ -105,10 +214,61 @@ class GroupSpec:
     z_num: int = 0             # 0/0 -> even share of the unclaimed rows
     z_den: int = 0
     mesh: Tuple[int, ...] = () # per-group mesh shape; () -> (n_devices,)
+    modes: Tuple[str, ...] = ()  # canonical mode tokens; () -> unset
 
     @property
     def n_devices(self) -> int:
         return self.dev_hi - self.dev_lo + 1
+
+    # -- execution-mode views of the mode tokens ------------------------
+
+    @property
+    def fuse_k(self) -> int:
+        """Micro-steps per coupled round (the ``fuseK`` token; 1 = plain)."""
+        for t in self.modes:
+            if t.startswith("fuse"):
+                return int(t[4:])
+        return 1
+
+    @property
+    def kind(self) -> str:
+        """Forced fused-kernel kind: ``"stream"``, ``"padfree"``, or ``""``."""
+        for t in ("stream", "padfree"):
+            if t in self.modes:
+                return t
+        return ""
+
+    @property
+    def overlap_mode(self) -> bool:
+        return "overlap" in self.modes
+
+    @property
+    def pipeline_mode(self) -> bool:
+        return "pipeline" in self.modes
+
+    def with_modes(self, modes: Sequence[str]) -> "GroupSpec":
+        """This spec with its mode tokens replaced (canonical order)."""
+        return dataclasses.replace(self, modes=_canon_modes(tuple(modes)))
+
+    def canonical(self) -> str:
+        """The canonical clause text — the per-group ledger-identity
+        string auto-policy hashes (``config.groups_signature`` of one
+        clause), reconstructable from any spelling of the same group."""
+        head = [self.op]
+        if self.ratio > 1:
+            head.append("fine" if self.ratio == 2 else f"fine{self.ratio}")
+        if self.dtype:
+            head.append(_DTYPE_SHORT.get(self.dtype, self.dtype))
+        dev = (f"@{self.dev_lo}-{self.dev_hi}" if self.dev_hi != self.dev_lo
+               else f"@{self.dev_lo}")
+        tail = []
+        if self.z_den:
+            tail.append(f"z{self.z_num}/{self.z_den}")
+        if self.mesh:
+            tail.append("mesh" + "x".join(str(m) for m in self.mesh))
+        if self.modes:
+            tail.append("+".join(self.modes))
+        return ":".join(head) + dev + ("".join(":" + t for t in tail))
 
 
 def parse_groups(spec: str, n_devices: Optional[int] = None
@@ -158,6 +318,7 @@ def parse_groups(spec: str, n_devices: Optional[int] = None
                 "is descending")
         z_num = z_den = 0
         gmesh: Tuple[int, ...] = ()
+        modes: Tuple[str, ...] = ()
         for tok in [t for t in m.group("tail").split(":") if t]:
             if tok.startswith("mesh"):
                 try:
@@ -177,10 +338,19 @@ def parse_groups(spec: str, n_devices: Optional[int] = None
                     raise ValueError(
                         f"--groups clause {clause!r}: z-fraction "
                         f"{z_num}/{z_den} must lie strictly in (0, 1)")
+            elif tok.startswith("fuse") or tok.split("+")[0] in MODE_WORDS:
+                if modes:
+                    raise ValueError(
+                        f"--groups clause {clause!r}: more than one mode "
+                        f"token (join mode words with '+', e.g. "
+                        "stream+overlap)")
+                modes = _parse_modes(tok, clause)
             else:
                 raise ValueError(
                     f"--groups clause {clause!r}: unknown suffix {tok!r} "
-                    "(expected :z<num>/<den> or :mesh<m0>x<m1>...)")
+                    "(expected :z<num>/<den>, :mesh<m0>x<m1>..., or a "
+                    "'+'-joined mode token of fuse<K>/"
+                    + "/".join(MODE_WORDS) + ")")
         if gmesh and int(np.prod(gmesh)) != (d1 - d0 + 1):
             raise ValueError(
                 f"--groups clause {clause!r}: mesh {gmesh} needs "
@@ -188,7 +358,7 @@ def parse_groups(spec: str, n_devices: Optional[int] = None
                 f"holds {d1 - d0 + 1}")
         out.append(GroupSpec(op=op, ratio=ratio, dtype=dtype, dev_lo=d0,
                              dev_hi=d1, z_num=z_num, z_den=z_den,
-                             mesh=gmesh))
+                             mesh=gmesh, modes=modes))
     out.sort(key=lambda s: s.dev_lo)
     if out[0].dev_lo != 0:
         raise ValueError(
@@ -265,17 +435,24 @@ class GroupPlan:
             "grid": list(self.grid),
             "base_z": [self.base_z0, self.base_z1],
             "band": [self.band_lo, self.band_hi],
+            "modes": list(self.spec.modes),
+            # the canonical clause IS the group's ledger identity seed
+            # (obs/ledger per-group rows, policy per-group resolution):
+            # a log reader never re-derives it from the parts above
+            "clause": self.spec.canonical(),
         }
 
 
-def _band_width(st: Stencil) -> int:
+def _band_width(st: Stencil, k: int = 1) -> int:
     """Ghost-band rows per interior-facing side, in the group's units.
 
-    One step pollutes ``halo`` rows per phase inward from the frozen
-    guard frame, so a band of ``halo * phases`` rows absorbs exactly
-    one round's staleness and every owned row stays exact.
+    One micro-step pollutes ``halo`` rows per phase inward from the
+    frozen guard frame, so a band of ``k * halo * phases`` rows absorbs
+    exactly one round's staleness — ``k`` micro-steps under a ``fuseK``
+    mode token, mirroring the fused steppers' own exchange width — and
+    every owned row stays exact.
     """
-    return st.halo * max(1, len(st.phases or ()))
+    return int(k) * st.halo * max(1, len(st.phases or ()))
 
 
 def plan_groups(specs: Sequence[GroupSpec], base_grid: Sequence[int],
@@ -289,6 +466,16 @@ def plan_groups(specs: Sequence[GroupSpec], base_grid: Sequence[int],
     """
     base_grid = tuple(int(g) for g in base_grid)
     Z = base_grid[0]
+    # -- uniform micro-step count: every group advances the same number
+    # of micro-steps per coupled round (the bands are refreshed in
+    # lockstep), so a fuseK token must agree across ALL groups --
+    ks = sorted({s.fuse_k for s in specs})
+    if len(ks) > 1:
+        raise ValueError(
+            f"--groups: fuse factors {ks} differ between groups — every "
+            "group advances together per coupled round, so all clauses "
+            "must carry the same fuse<K> (or none)")
+    k = ks[0] if ks else 1
     # -- z extents: explicit fractions first, even split of the rest --
     extents: List[Optional[int]] = []
     claimed = 0
@@ -336,7 +523,7 @@ def plan_groups(specs: Sequence[GroupSpec], base_grid: Sequence[int],
             raise ValueError(
                 f"--groups: {s.op} is {st.ndim}D but the base grid "
                 f"{base_grid} has rank {len(base_grid)}")
-        m = _band_width(st)
+        m = _band_width(st, k)
         band_lo = m if i > 0 else 0
         band_hi = m if i < len(specs) - 1 else 0
         if ext * s.ratio <= band_lo + band_hi:
@@ -427,6 +614,54 @@ def _zslice(x, sl: slice):
     return x[(sl,) + (slice(None),) * (x.ndim - 1)]
 
 
+def _iface_geom(send: GroupPlan, recv: GroupPlan, up: bool
+                ) -> Tuple[int, int]:
+    """(band rows m on the receiver, raw source rows n_src on the sender).
+
+    The one place the cross-resolution row arithmetic lives — both
+    transports slice the SAME ``n_src`` sender rows adjacent to the
+    interface, so the collective path's shard-local resample sees
+    bit-identical inputs to the device_put path's sender-side resample.
+    """
+    m = recv.band_lo if up else recv.band_hi
+    rs, rr = send.spec.ratio, recv.spec.ratio
+    if rs >= rr:
+        n_src = m * (rs // rr)
+    else:
+        n_src = -(-m // (rr // rs))  # ceil: interpolation may overshoot
+    return m, n_src
+
+
+@dataclasses.dataclass
+class _WireDir:
+    """One interface direction's collective-transport plumbing.
+
+    A "wire" is the union-mesh array that carries this direction's raw
+    sender rows: every union device contributes one ``chunk_shape``
+    buffer (the sender group's shards contribute their staged slice,
+    everyone else a zero dummy), and the transport's single ppermute
+    moves the sender's edge-shard chunks to the receiver's edge shards,
+    y-position by y-position.
+    """
+
+    send_g: int
+    recv_g: int
+    up: bool                      # True: low group -> high group's lo band
+    idx: List[int]                # field indices on the wire
+    m: int                        # receiver band rows (receiver units)
+    n_src: int                    # raw sender rows (sender units)
+    chunk_shape: Tuple[int, ...]  # per-device wire buffer (F, n_src, ...)
+    dtype: Any                    # SENDER dtype: cast happens post-resample
+    perm: List[Tuple[int, int]]   # union-axis ppermute pairs, one per y
+    stage: Any = None             # jitted sender-side slice
+    stage_raw: Any = None         # unjitted, for make_jaxpr
+    wire_shape: Tuple[int, ...] = ()
+    wire_sharding: Any = None
+    recv_shape: Tuple[int, ...] = ()
+    recv_sharding: Any = None
+    dummies: Dict[Any, Any] = dataclasses.field(default_factory=dict)
+
+
 def _band_spec(ndim: int, mesh) -> PartitionSpec:
     """A band's sharding on the receiver: like the fields, z unsharded."""
     spec = list(stepper_lib.grid_partition_spec(ndim, mesh))
@@ -438,16 +673,30 @@ class CoupledRunner:
     """N groups, each on its own sub-mesh, coupled at interface faces.
 
     Host-orchestrated round loop: per round, every interface band is
-    refreshed from its neighbor's owned rows (slice -> resample ->
-    cast -> ``device_put`` -> splice), then every group's jitted
+    refreshed from its neighbor's owned rows, then every group's jitted
     runner is dispatched — JAX async dispatch runs the groups
     concurrently on their disjoint devices, which is the MPMD.
+
+    ``transport`` selects the band refresh path: ``"device_put"``
+    (slice -> resample -> cast on the sender, host-ordered move) or
+    ``"collective"`` (raw rows edge shard to edge shard via ppermute
+    inside one union-mesh shard_map, resample + cast shard-locally on
+    the receiver — bit-identical to the device_put path, zero host
+    hops in the step).  A group whose clause carries mode tokens runs
+    the matching fused/overlap/pipeline stepper; a forced kind the
+    builder declines raises by name.
     """
 
     def __init__(self, plans: Sequence[GroupPlan], seed: int = 0,
-                 density: float = 0.15, init_kind: str = "auto"):
+                 density: float = 0.15, init_kind: str = "auto",
+                 transport: str = TRANSPORT_BACKEND):
+        if transport not in TRANSPORTS:
+            raise ValueError(
+                f"--group-transport {transport!r} is not one of "
+                f"{list(TRANSPORTS)}")
         self.plans = tuple(plans)
         self.n_groups = len(self.plans)
+        self.transport = transport
         self.round = 0
         self.meshes = []
         self.fields: List[Fields] = []
@@ -456,12 +705,46 @@ class CoupledRunner:
         for p in self.plans:
             msh = mesh_lib.make_mesh(p.mesh_shape, devices=p.devices())
             self.meshes.append(msh)
-            step = stepper_lib.make_sharded_step(p.stencil, msh, p.grid)
+            step = self._make_group_step(p, msh)
             self._step_fns.append(step)
             self._runners.append(make_runner(step, 1))
             self.fields.append(self._init_group(p, msh, seed, density,
                                                 init_kind))
-        self._sends, self._splices = self._build_transfers()
+        if transport == "collective":
+            self._build_collective()
+            self._sends, self._splices = [], []
+        else:
+            self._sends, self._splices = self._build_transfers()
+
+    def _make_group_step(self, p: GroupPlan, msh):
+        """The group's interior stepper, per its clause mode tokens.
+
+        Mode tokens route to the UNMODIFIED monolithic builders:
+        ``fuseK``/``stream``/``padfree``/``pipeline`` through
+        ``make_sharded_temporal_step`` (k micro-steps per round),
+        ``overlap`` alone through the plain sharded stepper's
+        interior/boundary split.  A forced kind the builder declines
+        RAISES — a mode token never silently degrades (overlap keeps
+        the monolithic soft-fallback contract: check
+        ``step._overlap_active``).
+        """
+        s = p.spec
+        if s.kind or s.fuse_k > 1 or s.pipeline_mode:
+            step = stepper_lib.make_sharded_temporal_step(
+                p.stencil, msh, p.grid, s.fuse_k,
+                kind=s.kind or None, overlap=s.overlap_mode,
+                pipeline=s.pipeline_mode)
+            if step is None:
+                raise ValueError(
+                    f"--groups: group {p.name} forces mode "
+                    f"{'+'.join(s.modes)!r} but the fused builder "
+                    f"declines grid {p.grid} on mesh {p.mesh_shape} — "
+                    "forced modes never fall back silently")
+            return step
+        if s.overlap_mode:
+            return stepper_lib.make_sharded_step(p.stencil, msh, p.grid,
+                                                 overlap=True)
+        return stepper_lib.make_sharded_step(p.stencil, msh, p.grid)
 
     # -- construction ---------------------------------------------------
 
@@ -518,15 +801,9 @@ class CoupledRunner:
 
     def _make_send(self, send: GroupPlan, recv: GroupPlan, up: bool):
         """Jitted sender-side transfer: slice owned rows, resample, cast."""
-        m = recv.band_lo if up else recv.band_hi
+        m, n_src = _iface_geom(send, recv, up)
         rs, rr = send.spec.ratio, recv.spec.ratio
         oz0, oz1 = send.owned_z
-        if rs >= rr:
-            f = rs // rr
-            n_src = m * f
-        else:
-            f = rr // rs
-            n_src = -(-m // f)  # ceil: interpolation may overshoot
         # the sender rows adjacent to the interface
         src = (slice(oz1 - n_src, oz1) if up else slice(oz0, oz0 + n_src))
         idx = self._exchange_idx(send, recv)
@@ -569,6 +846,274 @@ class CoupledRunner:
 
         return splice
 
+    # -- collective interface transport ---------------------------------
+    #
+    # Three jitted stages per round, zero host hops in any of them:
+    #
+    #   stage      per direction, on the SENDER mesh: every z-shard
+    #              statically slices its own interface-adjacent rows of
+    #              the stacked exchanged fields (only the edge shard's
+    #              slice is ever read off the wire).
+    #   transport  ONE shard_map over the union device set whose body is
+    #              exactly one lax.ppermute per wire — 2 * n_interfaces
+    #              total, the count assert_group_transport_structure pins.
+    #   splice     per receiver group, donating: resample + cast the
+    #              landed chunk SHARD-LOCALLY (sender dtype, same op
+    #              order as _make_send — bit-identical), gate the band
+    #              write on axis_index == edge shard.
+    #
+    # Between stages the buffers are rewrapped zero-copy between the
+    # group meshes and the union mesh via
+    # jax.make_array_from_single_device_arrays; the only device_put is
+    # the one-time zero-dummy allocation at __init__.
+
+    def _mesh_zy(self, p: GroupPlan) -> Tuple[int, int]:
+        """(z-shards, y-shards) of a group mesh; axes past y must be 1."""
+        ms = p.mesh_shape
+        nz = ms[0] if len(ms) >= 1 else 1
+        ny = ms[1] if len(ms) >= 2 else 1
+        if any(c > 1 for c in ms[2:]):
+            raise ValueError(
+                f"--group-transport collective: group {p.name} mesh "
+                f"{ms} shards a grid axis past (z, y) — edge-shard "
+                "pairing is defined on z/y meshes only; drop the axis "
+                "or use --group-transport device_put")
+        return nz, ny
+
+    def _build_collective(self) -> None:
+        n_union = self.plans[-1].spec.dev_hi + 1
+        self._union_devs = list(jax.devices()[:n_union])
+        self._union_mesh = Mesh(np.asarray(self._union_devs), ("u",))
+        dirs: List[_WireDir] = []
+        for lo, hi in zip(self.plans, self.plans[1:]):
+            for up in (True, False):
+                send, recv = (lo, hi) if up else (hi, lo)
+                dirs.append(self._make_wire_dir(send, recv, up))
+        self._cdirs = dirs
+        self._ctransport, self._ctransport_raw = self._make_ctransport()
+        self._csplices = []
+        self._csplice_raws = []
+        for g, p in enumerate(self.plans):
+            lo_d = next((d for d in dirs if d.recv_g == g and d.up), None)
+            hi_d = next((d for d in dirs if d.recv_g == g and not d.up),
+                        None)
+            sp, raw = self._make_csplice(p, self.meshes[g], lo_d, hi_d)
+            self._csplices.append(sp)
+            self._csplice_raws.append(raw)
+
+    def _make_wire_dir(self, send: GroupPlan, recv: GroupPlan, up: bool
+                       ) -> _WireDir:
+        m, n_src = _iface_geom(send, recv, up)
+        idx = self._exchange_idx(send, recv)
+        nz_s, ny_s = self._mesh_zy(send)
+        nz_r, ny_r = self._mesh_zy(recv)
+        if ny_s != ny_r:
+            raise ValueError(
+                f"--group-transport collective: interface "
+                f"{send.name}|{recv.name} pairs edge shards y-position "
+                f"by y-position, so both groups need the SAME y-shard "
+                f"count (got {ny_s} vs {ny_r}); match the :mesh clauses "
+                "or use --group-transport device_put")
+        ny = ny_s
+        zloc_s = send.grid[0] // nz_s
+        # rows the sender's edge shard must hold PAST its own ghost band
+        guard = send.band_hi if up else send.band_lo
+        if n_src + guard > zloc_s:
+            raise ValueError(
+                f"--group-transport collective: {recv.name}'s band "
+                f"needs {n_src} owned row(s) plus {guard} band row(s) "
+                f"resident on {send.name}'s edge z-shard, but each of "
+                f"its {nz_s} shard(s) holds only {zloc_s} rows — use "
+                f"fewer z-shards in {send.name}'s mesh")
+        zloc_r = recv.grid[0] // nz_r
+        if m > zloc_r:
+            raise ValueError(
+                f"--group-transport collective: {recv.name}'s {m}-row "
+                f"band exceeds its own edge shard's {zloc_r} local rows "
+                f"— use fewer z-shards in {recv.name}'s mesh")
+        y_loc = send.grid[1] // ny if send.stencil.ndim >= 2 else 1
+        chunk = ((len(idx), n_src, y_loc) + tuple(send.grid[2:])
+                 if send.stencil.ndim >= 2 else (len(idx), n_src))
+        # edge shards: sender's interface-facing z row of shards to the
+        # receiver's, same y position (mesh reshape is row-major, so
+        # device (z, y) = dev_lo + z*ny + y)
+        ez_s = nz_s - 1 if up else 0
+        ez_r = 0 if up else nz_r - 1
+        perm = [(send.spec.dev_lo + ez_s * ny + y,
+                 recv.spec.dev_lo + ez_r * ny + y) for y in range(ny)]
+        d = _WireDir(send_g=send.index, recv_g=recv.index, up=up, idx=idx,
+                     m=m, n_src=n_src, chunk_shape=chunk,
+                     dtype=send.stencil.dtype, perm=perm)
+        # -- sender-side stage: every z-shard slices its local rows
+        # adjacent to the interface (band rows excluded) --
+        msh = self.meshes[send.index]
+        gspec = stepper_lib.grid_partition_spec(send.stencil.ndim, msh)
+        spec = PartitionSpec(None, *gspec)
+        sl = (slice(zloc_s - guard - n_src, zloc_s - guard) if up
+              else slice(guard, guard + n_src))
+        field_idx = list(idx)
+
+        def stage_raw(fields: Fields):
+            arr = jnp.stack([fields[i] for i in field_idx])
+
+            def body(a):
+                return a[(slice(None), sl)]
+
+            return stepper_lib.shard_map(
+                body, msh, in_specs=(spec,), out_specs=spec,
+                check_vma=False)(arr)
+
+        d.stage_raw = stage_raw
+        d.stage = jax.jit(stage_raw)
+        n_union = len(self._union_devs)
+        d.wire_shape = (chunk[0], n_union * n_src) + chunk[2:]
+        d.wire_sharding = NamedSharding(
+            self._union_mesh,
+            PartitionSpec(None, "u", *([None] * (len(chunk) - 2))))
+        rmesh = self.meshes[recv.index]
+        rspec = stepper_lib.grid_partition_spec(recv.stencil.ndim, rmesh)
+        d.recv_shape = ((chunk[0], nz_r * n_src, ny * chunk[2])
+                        + chunk[3:] if len(chunk) > 2
+                        else (chunk[0], nz_r * n_src))
+        d.recv_sharding = NamedSharding(rmesh, PartitionSpec(None, *rspec))
+        # one-time zero dummies for union devices outside the sender
+        # group (the only device_put on the collective path, at build
+        # time — never per round)
+        send_devs = set(send.devices())
+        for dev in self._union_devs:
+            if dev not in send_devs:
+                d.dummies[dev] = jax.device_put(
+                    jnp.zeros(chunk, d.dtype), dev)
+        return d
+
+    def _make_ctransport(self):
+        """The single union-mesh shard_map: one ppermute per wire."""
+        dirs = self._cdirs
+        umesh = self._union_mesh
+        specs = tuple(
+            PartitionSpec(None, "u", *([None] * (len(d.chunk_shape) - 2)))
+            for d in dirs)
+        perms = [list(d.perm) for d in dirs]
+
+        def transport_raw(*wires):
+            def body(*chunks):
+                return tuple(
+                    jax.lax.ppermute(c, "u", pm)
+                    for c, pm in zip(chunks, perms))
+
+            return stepper_lib.shard_map(
+                body, umesh, in_specs=specs, out_specs=specs,
+                check_vma=False)(*wires)
+
+        return jax.jit(transport_raw), transport_raw
+
+    def _make_csplice(self, p: GroupPlan, msh, lo_d: Optional[_WireDir],
+                      hi_d: Optional[_WireDir]):
+        """Donating receive-side splice: resample shard-locally, gate on
+        the edge shard, write the band rows."""
+        ndim = p.stencil.ndim
+        nz, _ny = self._mesh_zy(p)
+        zloc = p.grid[0] // nz
+        gspec = stepper_lib.grid_partition_spec(ndim, msh)
+        zname = gspec[0]
+        nf = p.stencil.num_fields
+        fspec = PartitionSpec(*gspec)
+        active = [d for d in (lo_d, hi_d) if d is not None]
+        chunk_specs = tuple(PartitionSpec(None, *gspec) for _ in active)
+        rdtype = p.stencil.dtype
+
+        def resample(x, d: _WireDir):
+            rs = self.plans[d.send_g].spec.ratio
+            rr = p.spec.ratio
+            if rs > rr:
+                x = restrict(x, rs // rr)
+            elif rr > rs:
+                x = interpolate(x, rr // rs)
+                n = x.shape[0]
+                x = _zslice(x, slice(n - d.m, n) if d.up
+                            else slice(0, d.m))
+            return x.astype(rdtype)
+
+        def splice_raw(fields: Fields, *chunks):
+            def body(*args):
+                fs = list(args[:nf])
+                for d, chunk in zip(active, args[nf:]):
+                    lo = d.up  # up-direction chunks land in the lo band
+                    edge = 0 if lo else nz - 1
+                    sl = slice(0, d.m) if lo else slice(zloc - d.m, zloc)
+                    for j, i in enumerate(d.idx):
+                        band = resample(chunk[j], d)
+                        cur = fs[i][sl]
+                        if zname is not None and nz > 1:
+                            onedge = jax.lax.axis_index(zname) == edge
+                            band = jnp.where(onedge, band, cur)
+                        fs[i] = fs[i].at[sl].set(band)
+                return tuple(fs)
+
+            return stepper_lib.shard_map(
+                body, msh, in_specs=(fspec,) * nf + chunk_specs,
+                out_specs=(fspec,) * nf, check_vma=False)(*fields, *chunks)
+
+        return (functools.partial(jax.jit, donate_argnums=0)(splice_raw),
+                splice_raw)
+
+    def _wire(self, d: _WireDir, staged) -> jax.Array:
+        """Zero-copy rewrap: staged per-device buffers -> union-mesh wire."""
+        by_dev = {s.device: s.data for s in staged.addressable_shards}
+        bufs = [by_dev[dev] if dev in by_dev else d.dummies[dev]
+                for dev in self._union_devs]
+        return jax.make_array_from_single_device_arrays(
+            d.wire_shape, d.wire_sharding, bufs)
+
+    def _unwire(self, d: _WireDir, wire) -> jax.Array:
+        """Zero-copy rewrap: wire buffers at the receiver's devices ->
+        an array on the receiver's own mesh."""
+        by_dev = {s.device: s.data for s in wire.addressable_shards}
+        bufs = [by_dev[dev] for dev in self.plans[d.recv_g].devices()]
+        return jax.make_array_from_single_device_arrays(
+            d.recv_shape, d.recv_sharding, bufs)
+
+    def _exchange_collective(self) -> None:
+        staged = [self._wire(d, d.stage(self.fields[d.send_g]))
+                  for d in self._cdirs]
+        moved = self._ctransport(*staged)
+        landed: Dict[Tuple[int, bool], jax.Array] = {}
+        for d, w in zip(self._cdirs, moved):
+            landed[(d.recv_g, d.up)] = self._unwire(d, w)
+        for g in range(self.n_groups):
+            chunks = [landed[(g, up)] for up in (True, False)
+                      if (g, up) in landed]
+            if chunks:
+                self.fields[g] = self._csplices[g](
+                    tuple(self.fields[g]), *chunks)
+
+    def collective_jaxprs(self) -> Dict[str, Any]:
+        """Stage / transport / splice jaxprs for the transport gate
+        (``jaxprcheck.assert_group_transport_structure``)."""
+        if self.transport != "collective":
+            raise ValueError(
+                "collective_jaxprs needs transport='collective' "
+                f"(this runner uses {self.transport!r})")
+        def avals(fs):
+            return tuple(jax.ShapeDtypeStruct(f.shape, f.dtype)
+                         for f in fs)
+        stages = [jax.make_jaxpr(d.stage_raw)(avals(
+            self.fields[d.send_g])) for d in self._cdirs]
+        wire_avals = [jax.ShapeDtypeStruct(d.wire_shape, d.dtype)
+                      for d in self._cdirs]
+        transport = jax.make_jaxpr(self._ctransport_raw)(*wire_avals)
+        splices = []
+        for g, raw in enumerate(self._csplice_raws):
+            chunks = [jax.ShapeDtypeStruct(d.recv_shape, d.dtype)
+                      for up in (True, False) for d in self._cdirs
+                      if d.recv_g == g and d.up is up]
+            if chunks:
+                splices.append(jax.make_jaxpr(raw)(
+                    avals(self.fields[g]), *chunks))
+        return {"stage": stages, "transport": transport,
+                "splice": splices,
+                "n_interfaces": self.n_groups - 1}
+
     # -- the round loop -------------------------------------------------
 
     def exchange(self) -> None:
@@ -578,6 +1123,9 @@ class CoupledRunner:
         splices donate their input buffers, so every read of the
         pre-round state must land first.
         """
+        if self.transport == "collective":
+            self._exchange_collective()
+            return
         staged_lo: List[Fields] = [() for _ in self.plans]
         staged_hi: List[Fields] = [() for _ in self.plans]
         for k, (send_up, send_dn) in enumerate(self._sends):
@@ -622,12 +1170,34 @@ class CoupledRunner:
     # -- inspection / gates ---------------------------------------------
 
     def step_jaxprs(self):
-        """Per-group step jaxprs (for ``assert_coupled_structure``)."""
-        return [jax.make_jaxpr(step)(tuple(f))
-                for step, f in zip(self._step_fns, self.fields)]
+        """Per-group step jaxprs (for ``assert_coupled_structure``).
+
+        A pipelined group step carries slab state, so its one-round
+        jaxpr is traced through the same ``pipeline_hooks`` seam the
+        runner uses (seed + one advance).
+        """
+        out = []
+        for step, f in zip(self._step_fns, self.fields):
+            seed, advance = pipeline_hooks(step)
+            out.append(jax.make_jaxpr(
+                lambda fs, _s=seed, _a=advance: _a(fs, _s(fs))[0]
+            )(tuple(f)))
+        return out
 
     def transfer_jaxprs(self):
-        """Interface transfer jaxprs: slice+resample+cast, per direction."""
+        """Interface transfer jaxprs: slice+resample+cast, per direction.
+
+        Under the collective transport the sender-side work is the
+        stage (slice only — resample/cast moved to the receive splice);
+        its jaxprs stand in here so ``assert_coupled_structure``'s
+        no-cross-group-collective scan still covers the sender path.
+        """
+        if self.transport == "collective":
+            def avals(fs):
+                return tuple(jax.ShapeDtypeStruct(f.shape, f.dtype)
+                             for f in fs)
+            return [jax.make_jaxpr(d.stage_raw)(avals(
+                self.fields[d.send_g])) for d in self._cdirs]
         out = []
         for k, (send_up, send_dn) in enumerate(self._sends):
             out.append(jax.make_jaxpr(send_up)(tuple(self.fields[k])))
@@ -755,4 +1325,5 @@ __all__ = [
     "GroupSpec", "GroupPlan", "CoupledRunner", "parse_groups",
     "plan_groups", "plans_from_config", "interpolate", "restrict",
     "interface_traffic", "groups_signature", "TRANSPORT_BACKEND",
+    "TRANSPORTS", "MODE_WORDS", "MODE_CANDIDATES",
 ]

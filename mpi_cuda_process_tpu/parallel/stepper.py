@@ -538,10 +538,16 @@ def make_sharded_fused_step(
                 "--fuse-kind stream, or use --exchange ppermute for "
                 f"kind={kind!r}")
     if variant is not None:
-        # Kernel variants (policy/autotune.py) ride the streaming kernel
-        # family only — the swept constants (ring depth, chunk geometry,
-        # strip shape) are streaming/rdma kernel knobs, and a forced
-        # variant never silently runs the default-constant kernel.
+        # Sharded kernel variants (policy/autotune.py) ride the streaming
+        # kernel family only — the swept constants (ring depth, chunk
+        # geometry, strip shape) are streaming/rdma kernel knobs, and a
+        # forced variant never silently runs the default-constant kernel.
+        if getattr(variant, "family", "") == "tiled":
+            raise ValueError(
+                f"kernel variant {variant.id!r} sweeps the unsharded "
+                "padded-window kernel's tiles; sharded runs have no "
+                "tiled kind (drop --mesh or pick a stream-family "
+                "variant)")
         if kind != "stream":
             raise ValueError(
                 f"kernel variant {variant.id!r} rides the streaming "

@@ -75,12 +75,19 @@ class KernelVariant:
     sublane multiple covering the k-step halo ``wm``); ``order``
     permutes the strip-grid traversal (``"rev"`` walks the y strips
     high-to-low, ``"xy"`` makes the x windows the outer grid axis —
-    x-windowed strips only).  Zero fields are "not overridden": a
-    variant with every constant zero compiles the byte-identical
-    default kernel.
+    x-windowed strips only).  ``family="tiled"`` (round 23 — the last
+    ROADMAP item-4 residue): ``(bz, by)`` is the explicit window-tile
+    geometry handed to the UNSHARDED padded 4-block kernel
+    (``ops/pallas/fused.build_fused_call``'s ``tiles=``, hosted by
+    ``--fuse-kind tiled``), validated through the builder's own
+    ``_tiles_valid`` gate plus ``_pick_tiles``'s VMEM cost model —
+    the sweep explores dimensions the auto picker's {8..64} grid never
+    scores (128-row strips, deep z columns).  Zero fields are "not
+    overridden": a variant with every constant zero compiles the
+    byte-identical default kernel.
     """
     id: str
-    family: str            # "rdma" | "stream"
+    family: str            # "rdma" | "stream" | "tiled"
     nslots: int = 0
     prefer_nc: int = 0
     bz: int = 0
@@ -111,20 +118,31 @@ VARIANTS: Dict[str, KernelVariant] = {v.id: v for v in (
     KernelVariant(id="mg32", family="stream", margin=32),
     KernelVariant(id="orev", family="stream", order="rev"),
     KernelVariant(id="oxy", family="stream", order="xy"),
+    # tiled family, round 23: explicit window tiles for the unsharded
+    # padded kernel — shapes OUTSIDE the auto picker's {8..64} scan
+    # (the picker maximizes core/window ratio; these trade it for
+    # longer sublane runs / fewer tail-window reassemblies)
+    KernelVariant(id="tz8y128", family="tiled", bz=8, by=128),
+    KernelVariant(id="tz32y128", family="tiled", bz=32, by=128),
+    KernelVariant(id="tz128y32", family="tiled", bz=128, by=32),
 )}
 
 STREAM_SWEEP: Tuple[str, ...] = ("bz16y16", "bz8y8", "bz16y32",
                                  "mg16", "mg32", "orev", "oxy")
 RDMA_SWEEP: Tuple[str, ...] = ("ring3", "ring4", "nc8")
+TILED_SWEEP: Tuple[str, ...] = ("tz8y128", "tz32y128", "tz128y32")
+
+_SWEEPS: Dict[str, Tuple[str, ...]] = {
+    "stream": STREAM_SWEEP, "rdma": RDMA_SWEEP, "tiled": TILED_SWEEP}
 
 
 def tune_variant(family: str, n: int) -> KernelVariant:
     """The campaign's ``tune<n>`` (1-based) variant of ``family`` —
     the label contract between measure.py and this registry."""
-    sweep = {"stream": STREAM_SWEEP, "rdma": RDMA_SWEEP}.get(family)
+    sweep = _SWEEPS.get(family)
     if sweep is None:
         raise ValueError(f"unknown variant family {family!r} "
-                         f"(known: stream, rdma)")
+                         f"(known: stream, rdma, tiled)")
     if not 1 <= n <= len(sweep):
         raise ValueError(f"tune{n}: family {family!r} has "
                          f"{len(sweep)} swept variants")
@@ -150,13 +168,24 @@ def _config_reason(cfg: RunConfig, v: KernelVariant) -> Optional[str]:
     """Why ``cfg`` cannot host ``v`` at all (family prerequisites) —
     None when the config is variant-eligible."""
     if len(cfg.grid) != 3:
-        return "kernel variants cover the 3D streaming families only"
+        return "kernel variants cover the 3D fused kernel families only"
     if not cfg.fuse:
         return ("kernel variants tune the temporal-blocking kernels: "
                 "needs an explicit --fuse K")
+    if v.family == "tiled":
+        # the padded window kernel is unsharded-only (cli rejects
+        # --fuse-kind tiled under --mesh): the opposite prerequisites
+        # of the streaming families
+        if cfg.fuse_kind != "tiled":
+            return (f"variant {v.id} sweeps the padded window kernel's "
+                    "explicit tiles: force --fuse-kind tiled")
+        if cfg.mesh and math.prod(cfg.mesh) > 1:
+            return ("the tiled window kernel is unsharded-only (sharded "
+                    "runs ride the stream/padfree kinds): drop --mesh")
+        return None
     if cfg.fuse_kind != "stream":
-        return ("kernel variants ride the streaming kernel family: "
-                "force --fuse-kind stream")
+        return ("stream/rdma kernel variants ride the streaming kernel "
+                "family: force --fuse-kind stream")
     if not cfg.mesh or math.prod(cfg.mesh) <= 1:
         return ("kernel variants tune the sharded exchange/strip "
                 "schedule: needs --mesh")
@@ -198,6 +227,53 @@ def validate_variant(v: KernelVariant, cfg: RunConfig,
     sub = _sublane(itemsize)
     two_axis = counts[1] > 1
     k = int(cfg.fuse)
+
+    if v.family == "tiled":
+        # Explicit window tiles for the unsharded padded 4-block kernel:
+        # the builder's own _tiles_valid gate, itemized first with named
+        # reasons, then _pick_tiles's VMEM cost model (window margin =
+        # the raw k-step margin m — the padded kernel assembles
+        # (bz+2m, by+2m, X) windows, not the pad-free 2m).
+        from ..ops.pallas import fused as fused_lib
+
+        if not fused_lib.fused_supported(st):
+            return False, f"{st.name} has no fused micro family"
+        if not v.bz:
+            return False, (f"variant {v.id} carries no tiles: the tiled "
+                           "family sweeps explicit (bz, by) window "
+                           "geometry only")
+        wm = k * _halo_per_micro(st)
+        bz, by = v.bz, v.by
+        Z, Y, X = local  # unsharded (gated above): local IS the grid
+        if (2 * wm) % sub:
+            return False, (f"sublane-misaligned: 2*margin={2 * wm} is "
+                           f"not a multiple of the dtype's sublane tile "
+                           f"({sub} for itemsize {itemsize}) — no tile "
+                           "choice can fix k for this dtype")
+        if bz % (2 * wm) or by % (2 * wm):
+            return False, (f"tiles ({bz}, {by}) are not multiples of "
+                           f"2*margin={2 * wm}: the window-tail "
+                           "BlockSpecs degenerate into silently-wrong "
+                           "geometry (the _tiles_valid gate)")
+        if Z % bz:
+            return False, f"bz={bz} does not divide Z={Z}"
+        if Y % by:
+            return False, f"by={by} does not divide Y={Y}"
+        if not fused_lib._tiles_valid(Z, Y, bz, by, wm, itemsize):
+            return False, (f"tile gates reject variant {v.id} for grid "
+                           f"{local} at margin {wm}")
+        isz = max(itemsize, 4)  # sub-f32 budgets as f32 (_pick_tiles)
+        lx_r = fused_lib._lane_round(X)
+        window = (bz + 2 * wm) * (by + 2 * wm) * lx_r * isz
+        core = bz * by * lx_r * isz
+        nfields = fused_lib._MICRO[st.name][2]
+        live = (7 * window + 2 * core) * nfields
+        if live > fused_lib._VMEM_LIMIT:
+            return False, (f"VMEM overflow: window live set {live} B > "
+                           f"limit {fused_lib._VMEM_LIMIT} B for tiles "
+                           f"({bz}, {by})")
+        return True, None
+
     if not streamfused.stream_supported(st):
         return False, f"{st.name} has no streaming micro family"
     wm = k * _halo_per_micro(st)
@@ -346,7 +422,7 @@ def prioritize_sweep(attribution: Optional[Dict[str, Any]],
     shape first.  Without a usable attribution the given order is
     kept (the caller lists the config's own family first).
     """
-    fams = [f for f in families if f in ("stream", "rdma")]
+    fams = [f for f in families if f in _SWEEPS]
     if len(fams) < 2:
         return fams
     att = attribution or {}
@@ -357,19 +433,25 @@ def prioritize_sweep(attribution: Optional[Dict[str, Any]],
     total = compute + exposed
     comm_bound = total > 0 and exposed / total > 0.25
     order = ("rdma", "stream") if comm_bound else ("stream", "rdma")
-    return [f for f in order if f in fams]
+    # attribution only arbitrates the transport-vs-block-shape pair;
+    # any other family (tiled) keeps its given position at the tail
+    return ([f for f in order if f in fams]
+            + [f for f in fams if f not in order])
 
 
 def sweep_ids(cfg: RunConfig,
               attribution: Optional[Dict[str, Any]] = None) -> List[str]:
     """The variant ids eligible for ``cfg``, family-prioritized."""
-    # the config's own transport family leads by default; a usable
+    # the config's own kernel family leads by default; a usable
     # profiler attribution (when available) overrides the order
-    families = (["rdma", "stream"] if cfg.exchange == "rdma"
-                else ["stream"])
+    if cfg.fuse_kind == "tiled":
+        families = ["tiled"]
+    else:
+        families = (["rdma", "stream"] if cfg.exchange == "rdma"
+                    else ["stream"])
     out: List[str] = []
     for fam in prioritize_sweep(attribution, families) or families:
-        out += list({"stream": STREAM_SWEEP, "rdma": RDMA_SWEEP}[fam])
+        out += list(_SWEEPS[fam])
     return out
 
 
@@ -424,8 +506,9 @@ def maybe_autotune(cfg: RunConfig,
     the (op, shape, dtype, mesh, exchange) tuple changes; winners are
     durable ledger rows, not per-run state.
     """
+    probe_family = TILED_SWEEP if cfg.fuse_kind == "tiled" else STREAM_SWEEP
     reason = _config_reason(
-        cfg, VARIANTS[STREAM_SWEEP[0]])  # family prereqs, stream baseline
+        cfg, VARIANTS[probe_family[0]])  # the config's own family prereqs
     if reason:
         raise ValueError(f"--autotune: {reason}")
     backend = backend or jax.default_backend()

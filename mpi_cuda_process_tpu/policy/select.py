@@ -51,6 +51,23 @@ kind — re-resolves, and the migration seam re-shards live
 ``FAULT_INJECT``): at the first recheck at-or-after step N, the rows in
 PATH are appended to the active ledger, so a tier-1 smoke can flip the
 measured winner under a running simulation.
+
+**Coupled runs (round 23).**  A ``--groups`` config resolves PER
+GROUP: each clause whose mode tokens are unset is ranked against the
+ledger's per-group rows (``obs/ledger._group_rows`` — label
+``cli_grp_<op>``, baseline key carrying the clause signature
+``|grp:<sig>`` and the interface transport ``|gtx:<transport>``) over
+the ``parallel.groups.MODE_CANDIDATES`` mode combinations.  Strictly
+measured-beats-default, with NO roofline fallback: a mode combination
+is adopted only when this exact clause was actually measured under it
+(an ``ok`` row), so an infeasible mode — one whose stepper builder
+would decline this group's geometry — can never be picked, because it
+could never have produced a measurement.  A clause that carries
+explicit mode tokens is locked, exactly like an explicit flag.  The
+decision records one entry per group (``group_decisions``) plus the
+resolved canonical spec (``groups``), and ``perf_gate --policy-check``
+replays both: the check trips when ANY single group's winner moves,
+even though the run label does not change with mode tokens alone.
 """
 
 from __future__ import annotations
@@ -286,15 +303,18 @@ def candidates(cfg: RunConfig, backend: str,
     if "kernel_variant" not in locked:
         # the kernel-variant dimension (policy/autotune.py): for every
         # mode combination that hosts variants (streaming fused kernels
-        # under a mesh), also propose each registry variant feasible
-        # for its family — measured |var:<id> rows then outrank the
-        # default exactly like a measured mesh outranks a prediction
+        # under a mesh; the unsharded tiled window kernel, round 23),
+        # also propose each registry variant feasible for its family —
+        # measured |var:<id> rows then outrank the default exactly like
+        # a measured mesh outranks a prediction
         from . import autotune as autotune_lib
 
         for d in list(modes_list):
             probe = _apply(cfg, locked, d)
-            if not (probe.fuse and probe.fuse_kind == "stream"
-                    and probe.mesh) or probe.kernel_variant:
+            hosts = probe.fuse and (
+                (probe.fuse_kind == "stream" and probe.mesh)
+                or (probe.fuse_kind == "tiled" and not probe.mesh))
+            if not hosts or probe.kernel_variant:
                 continue
             for vid in autotune_lib.sweep_ids(probe):
                 modes_list.append({**d, "kernel_variant": vid})
@@ -380,10 +400,15 @@ class Decision:
     requested: Dict[str, Any]         # mode fields before resolution
     overrides: Dict[str, Any]         # explicitly-passed (locked) fields
     table: List[Dict[str, Any]]       # ranked runner-up table
+    # coupled (--groups) resolutions only — empty/"" on monolithic runs
+    groups: str = ""                  # resolved CANONICAL --groups spec
+    requested_groups: str = ""        # the spec as the user wrote it
+    group_decisions: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)         # one entry per group, in order
 
     def as_event(self) -> Dict[str, Any]:
         """JSON-safe payload for the manifest ``policy`` event."""
-        return {
+        out = {
             "decision": _modes_of(self.config),
             "provenance": self.provenance,
             "label": self.label,
@@ -396,6 +421,125 @@ class Decision:
             "overrides": dict(self.overrides),
             "table": list(self.table),
         }
+        if self.group_decisions:
+            # only on coupled resolutions: every pre-existing
+            # monolithic policy event stays byte-identical
+            out["groups"] = self.groups
+            out["requested_groups"] = self.requested_groups
+            out["group_decisions"] = [dict(d) for d in
+                                      self.group_decisions]
+        return out
+
+
+def _group_identity(spec: Any, transport: str,
+                    backend: str) -> Tuple[str, str]:
+    """(label, baseline key) a per-group ledger row gets for one
+    clause — must mirror ``obs/ledger._group_rows`` exactly, so a
+    measured per-group row matches if and only if this clause (mode
+    tokens included, via the clause signature) was actually run under
+    this interface transport."""
+    label = ledger_lib.group_label(spec.op)
+    flags = ledger_lib.group_flags(spec.canonical(), transport)
+    bk = ledger_lib.baseline_key({"key": {
+        "label": label, "backend": backend, "flags": flags}})
+    return label, bk
+
+
+def _resolve_groups(cfg: RunConfig, backend: str, ledger_path: str,
+                    base_locked: FrozenSet[str],
+                    n_devices: int) -> Decision:
+    """Per-group mode resolution for a coupled config (round 23).
+
+    See the module docstring: strictly measured-beats-default over
+    ``MODE_CANDIDATES`` per unset-mode clause; explicit mode tokens
+    lock their clause; no roofline fallback (the monolithic model does
+    not describe a coupled round, and an unmeasured mode may be
+    infeasible for the group's geometry).
+    """
+    from ..parallel import groups as groups_lib
+
+    specs = groups_lib.parse_groups(cfg.groups)
+    transport = cfg.group_transport or groups_lib.TRANSPORT_BACKEND
+    try:
+        best = ledger_lib.best_known(ledger_lib.read_rows(ledger_path))
+    except ValueError as e:
+        log.warning("policy: unreadable ledger %s (%s) — groups keep "
+                    "their requested modes", ledger_path, e)
+        best = {}
+    group_decisions: List[Dict[str, Any]] = []
+    resolved: List[Any] = []
+    for g, spec in enumerate(specs):
+        name = f"g{g}:{spec.op}"
+        if spec.modes:
+            # explicit mode tokens are the user's call — locked, like
+            # an explicitly-passed mode flag on a monolithic run
+            label, bk = _group_identity(spec, transport, backend)
+            row = best.get(bk)
+            v = (float(row["value"]) if row is not None
+                 and row.get("unit") == "Mcells/s" else None)
+            group_decisions.append({
+                "group": name, "clause": spec.canonical(),
+                "modes": list(spec.modes), "locked": True,
+                "provenance": "measured" if v is not None
+                else "requested",
+                "label": label,
+                "value": round(v, 3) if v is not None else None,
+                "table": []})
+            resolved.append(spec)
+            continue
+        measured: List[Tuple[float, str, Tuple[str, ...], str]] = []
+        for modes in groups_lib.MODE_CANDIDATES:
+            cand = spec.with_modes(modes)
+            label, bk = _group_identity(cand, transport, backend)
+            row = best.get(bk)
+            if row is not None and row.get("unit") == "Mcells/s":
+                measured.append((float(row["value"]), cand.canonical(),
+                                 tuple(modes), label))
+        # determinism: value desc, then canonical clause — same total
+        # order contract as the monolithic ranking
+        measured.sort(key=lambda t: (-t[0], t[1]))
+        if measured:
+            value, _, modes, label = measured[0]
+            chosen = spec.with_modes(modes)
+            prov = "measured"
+        else:
+            chosen, prov, value = spec, "requested", None
+            label, _ = _group_identity(spec, transport, backend)
+        group_decisions.append({
+            "group": name, "clause": chosen.canonical(),
+            "modes": list(chosen.modes), "locked": False,
+            "provenance": prov, "label": label,
+            "value": round(value, 3) if value is not None else None,
+            "table": [{"modes": list(m), "value": round(v, 3),
+                       "clause": cl}
+                      for v, cl, m, _lb in measured][:4]})
+        resolved.append(chosen)
+    resolved_spec = ",".join(s.canonical() for s in resolved)
+    if any(tuple(ns.modes) != tuple(s.modes)
+           for ns, s in zip(resolved, specs)):
+        new_cfg = dataclasses.replace(cfg, groups=resolved_spec)
+    else:
+        new_cfg = cfg  # nothing moved: the run keeps its exact config
+    label, bk = _ledger_identity(new_cfg, backend)
+    row = None
+    r = best.get(bk)
+    if r is not None and r.get("unit") == "Mcells/s":
+        row = r
+    any_measured = any(d["provenance"] == "measured"
+                       for d in group_decisions)
+    provenance = ("measured" if row is not None or any_measured
+                  else "requested")
+    return Decision(
+        config=new_cfg, provenance=provenance, label=label,
+        value=(round(float(row["value"]), 3)
+               if row is not None else None),
+        unit="Mcells/s", backend=backend, n_devices=n_devices,
+        ledger_path=ledger_path,
+        requested={f: _json_val(getattr(cfg, f)) for f in MODE_FIELDS},
+        overrides={f: _json_val(getattr(cfg, f))
+                   for f in sorted(base_locked)},
+        table=[], groups=resolved_spec, requested_groups=cfg.groups,
+        group_decisions=group_decisions)
 
 
 def resolve(cfg: RunConfig, backend: Optional[str] = None,
@@ -420,6 +564,11 @@ def resolve(cfg: RunConfig, backend: Optional[str] = None,
         eff_locked = eff_locked | frozenset(
             f for f in MODE_FIELDS if f not in ADOPTABLE_FIELDS)
     n_devices = int(n_devices) if n_devices else jax.device_count()
+    if cfg.groups:
+        # coupled runs resolve PER GROUP (round 23, module docstring);
+        # the monolithic candidate enumeration does not describe them
+        return _resolve_groups(cfg, backend, ledger_path, base_locked,
+                               n_devices)
     st = _stencil_for(cfg)
     cands = candidates(cfg, backend, eff_locked, st, n_devices)
     try:

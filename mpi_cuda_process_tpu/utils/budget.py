@@ -431,15 +431,26 @@ def check_budget(
     return total, parts
 
 
-def estimate_coupled_bytes(plans) -> Tuple[int, list]:
+def estimate_coupled_bytes(plans, transport: str = "") -> Tuple[int, list]:
     """Per-device HBM estimate for a coupled ``--groups`` run.
 
     Each group is priced as its own run (:func:`estimate_run_bytes` on
     the group's stencil / local grid / sub-mesh — the group's interior
-    step IS the unmodified stepper, so its model applies verbatim),
-    plus the interface transients the coupling adds on that group's
-    devices: the ghost bands landed by ``device_put`` per round
-    (receiver side) and the staged resampled slices (sender side).
+    step IS the unmodified stepper, so the monolithic model applies
+    verbatim; round 23: the group's clause mode tokens flow into
+    ``fuse``/``fuse_kind``/``overlap``/``pipeline``, so a fused or
+    streamed group is priced exactly like the monolithic run it
+    mirrors), plus the interface transients the coupling adds on that
+    group's devices.  The two transports stage different tensors:
+
+    * ``device_put`` — the resampled band is built on the SENDER and
+      landed wholesale on the receiver: staged send (resampled,
+      recv-sized) + band recv per direction.
+    * ``collective`` — the RAW sender rows ride the ppermute wire and
+      are resampled shard-local on the receiver: raw staged rows
+      (send-sized) + the wire transient (one chunk per union device,
+      charged once) + band recv per direction.
+
     Interface transients are charged UNSHARDED per device — an upper
     bound consistent with the coarse-but-conservative contract.
 
@@ -449,25 +460,42 @@ def estimate_coupled_bytes(plans) -> Tuple[int, list]:
     """
     from ..parallel import groups as groups_lib
 
+    transport = transport or groups_lib.TRANSPORT_BACKEND
+    collective = transport == "collective"
     traffic = groups_lib.interface_traffic(plans)
     details = []
     worst = 0
     for g, p in enumerate(plans):
-        total, parts = estimate_run_bytes(p.stencil, p.grid,
-                                          mesh=p.mesh_shape)
+        s = p.spec
+        total, parts = estimate_run_bytes(
+            p.stencil, p.grid, mesh=p.mesh_shape,
+            fuse=s.fuse_k if s.fuse_k > 1 else 0,
+            fuse_kind=s.kind or "auto",
+            overlap=bool(s.overlap_mode), pipeline=bool(s.pipeline_mode))
         extra: List[Tuple[str, int]] = []
+
+        def _iface(t, send_dir, recv_dir):
+            send_b = t[send_dir]["send_bytes"]
+            recv_b = t[recv_dir]["recv_bytes"]
+            if collective:
+                extra.append((f"interface {t['interface']}: raw staged "
+                              f"rows ({send_dir})", send_b))
+                # wire transient: chunk-sized buffer per union device,
+                # charged once on this group's devices (upper bound)
+                extra.append((f"interface {t['interface']}: collective "
+                              f"wire chunk ({send_dir})", send_b))
+                extra.append((f"interface {t['interface']}: band recv "
+                              f"({recv_dir})", recv_b))
+            else:
+                extra.append((f"interface {t['interface']}: staged send "
+                              f"({send_dir})", send_b))
+                extra.append((f"interface {t['interface']}: band recv "
+                              f"({recv_dir})", recv_b))
+
         if g < len(traffic):  # this group is the low side of interface g
-            t = traffic[g]
-            extra.append((f"interface {t['interface']}: staged send (up)",
-                          t["up"]["send_bytes"]))
-            extra.append((f"interface {t['interface']}: band recv (down)",
-                          t["down"]["recv_bytes"]))
+            _iface(traffic[g], "up", "down")
         if g > 0:  # ... and the high side of interface g-1
-            t = traffic[g - 1]
-            extra.append((f"interface {t['interface']}: band recv (up)",
-                          t["up"]["recv_bytes"]))
-            extra.append((f"interface {t['interface']}: staged send "
-                          "(down)", t["down"]["send_bytes"]))
+            _iface(traffic[g - 1], "down", "up")
         parts = list(parts) + extra
         total += sum(b for _, b in extra)
         details.append((p.name, total, parts))
@@ -475,12 +503,12 @@ def estimate_coupled_bytes(plans) -> Tuple[int, list]:
     return worst, details
 
 
-def check_coupled_budget(plans, hbm_bytes: Optional[int] = None
-                         ) -> Tuple[int, list]:
+def check_coupled_budget(plans, hbm_bytes: Optional[int] = None,
+                         transport: str = "") -> Tuple[int, list]:
     """The ``check_budget`` analogue for a coupled run: raise ValueError
     with the worst group's arithmetic when any group cannot fit."""
     hbm = hbm_bytes if hbm_bytes is not None else device_hbm_bytes()
-    worst, details = estimate_coupled_bytes(plans)
+    worst, details = estimate_coupled_bytes(plans, transport=transport)
     for name, total, parts in details:
         if total > hbm:
             raise ValueError(

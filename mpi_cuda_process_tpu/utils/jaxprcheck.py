@@ -602,6 +602,90 @@ def check_coupled_structure(
     return report
 
 
+def assert_group_transport_structure(coll, n_interfaces: int = None
+                                     ) -> Dict[str, object]:
+    """The collective interface-transport gate (``parallel/groups.py``
+    ``transport="collective"``): the coupled exchange is device-to-device
+    ONLY, with the exact collective count.
+
+    ``coll`` is ``CoupledRunner.collective_jaxprs()`` — the stage /
+    transport / splice jaxprs of one exchange round.  Pins:
+
+    1. **Zero host-mediated transfer anywhere**: no ``device_put`` eqn
+       in any stage, the transport, or any splice — the only buffer
+       moves between the group meshes and the union mesh are the
+       zero-copy rewraps (which trace to nothing at all).
+    2. **Exact ppermute count**: the transport jaxpr carries exactly
+       ``2 * n_interfaces`` ppermutes — one per interface per
+       direction, no more (no duplicated round) and no fewer (no
+       silent fallback through an XLA resharding or a host hop).
+    3. **Everything else is collective-free**: stages (slice only) and
+       splices (shard-local resample + gated band write) carry zero
+       collectives of any kind; the transport carries no collective
+       BESIDES its ppermutes.
+
+    Returns the counts for the caller's report.
+    """
+    if n_interfaces is None:
+        n_interfaces = int(coll["n_interfaces"])
+    all_jaxprs = (list(coll["stage"]) + [coll["transport"]]
+                  + list(coll["splice"]))
+    n_dput = sum(count_primitive(c, "device_put") for c in all_jaxprs)
+    assert n_dput == 0, (
+        f"collective group transport contains {n_dput} device_put "
+        "eqn(s) — the coupled exchange must never take a host-mediated "
+        "hop")
+    for label, closed_list in (("stage", coll["stage"]),
+                               ("splice", coll["splice"])):
+        for t, closed in enumerate(closed_list):
+            for prim in _COLLECTIVES:
+                n = count_primitive(closed, prim)
+                assert n == 0, (
+                    f"collective transport {label} {t} contains {n} "
+                    f"{prim} eqn(s) — only the transport shard_map may "
+                    "communicate")
+    n_pp = count_primitive(coll["transport"], "ppermute")
+    expected = 2 * n_interfaces
+    assert n_pp == expected, (
+        f"collective transport jaxpr carries {n_pp} ppermute eqn(s), "
+        f"expected exactly {expected} (one per interface per direction "
+        f"across {n_interfaces} interface(s))")
+    for prim in _COLLECTIVES:
+        if prim == "ppermute":
+            continue
+        n = count_primitive(coll["transport"], prim)
+        assert n == 0, (
+            f"collective transport jaxpr contains {n} {prim} eqn(s) — "
+            "the ppermutes must be its only collectives")
+    return {"n_ppermute": n_pp, "n_device_put": n_dput,
+            "n_interfaces": n_interfaces,
+            "n_stages": len(coll["stage"]),
+            "n_splices": len(coll["splice"])}
+
+
+def check_group_transport_structure(
+    groups: str = "heat3d@0-3,heat3d@4-7",
+    grid: Tuple[int, ...] = (30, 16, 16),
+) -> Dict[str, object]:
+    """Build a collective-transport coupled runner on the current
+    devices and run both the coupling and the transport gates — the
+    tier-1 smoke's collective jaxpr gate.  Builds real (tiny) group
+    states but never steps them."""
+    from ..parallel import groups as groups_lib
+
+    plans = groups_lib.plans_from_config(
+        groups, grid, n_devices=len(jax.devices()))
+    runner = groups_lib.CoupledRunner(plans, transport="collective")
+    report = assert_coupled_structure(
+        runner.step_jaxprs(), runner.transfer_jaxprs(),
+        runner.sharded_group_indices())
+    report.update(assert_group_transport_structure(
+        runner.collective_jaxprs()))
+    report["groups"] = [p.name for p in plans]
+    report["transport"] = runner.transport
+    return report
+
+
 def check_pipeline_structure(
     stencil_name: str = "heat3d",
     grid: Tuple[int, int, int] = (32, 16, 128),

@@ -228,14 +228,21 @@ def _fmtv(v):
 
 def _groups_lines(status) -> list:
     """Coupled-run panel (parallel/groups.py): one row per device
-    group — op, resolution, dtype, devices, throughput, verdict —
-    already ranked worst verdict first by the metrics aggregator."""
+    group — op, resolution, dtype, devices, execution mode, throughput,
+    verdict — already ranked worst verdict first by the metrics
+    aggregator.  The header names the interface transport (round 23):
+    a collective run's bands ride ICI ppermute rounds, a device_put
+    run's ride host-mediated transfers."""
     groups = status.get("groups")
     if not groups:
         return []
     worst = groups.get("worst_verdict")
+    transport = next((r.get("transport")
+                      for r in groups.get("rows") or ()
+                      if r.get("transport")), None)
     head = (f"groups  {groups.get('n_groups', '?')} device groups "
             f"coupled at interface faces"
+            + (f"  transport={transport}" if transport else "")
             + (f"  worst={worst}" if worst else ""))
     rows = []
     for r in groups.get("rows") or ():
@@ -247,11 +254,15 @@ def _groups_lines(status) -> list:
         devs = r.get("devices")
         dev = ("-".join(map(str, devs)) if isinstance(devs, (list, tuple))
                and len(devs) == 2 else "-")
+        modes = r.get("modes")
+        mode = ("+".join(modes) if isinstance(modes, (list, tuple))
+                and modes else "plain")
         rows.append([
             r.get("group", "?"), r.get("op", "-"), res,
-            r.get("dtype", "-"), dev, gc, r.get("verdict") or "-"])
+            r.get("dtype", "-"), dev, mode, gc, r.get("verdict") or "-"])
     return [head, _table(rows, ["group", "op", "resolution", "dtype",
-                                "devices", "Gcells/s", "verdict"])]
+                                "devices", "mode", "Gcells/s",
+                                "verdict"])]
 
 
 def _health_lines(status) -> list:

@@ -168,7 +168,13 @@ def policy_check(manifest_path: str, ledger_path: str) -> int:
         k: tuple(v) if isinstance(v, list) else v
         for k, v in (event.get("requested") or {}).items()
         if k in policy_select.MODE_FIELDS}
-    cfg = RunConfig.from_dict({**(manifest.get("run") or {}), **requested})
+    run = dict(manifest.get("run") or {})
+    if event.get("requested_groups"):
+        # coupled (round 23): the run dict carries the RESOLVED groups
+        # spec (per-group mode tokens already applied) — restore the
+        # launch-time question so the per-group resolution replays
+        run["groups"] = event["requested_groups"]
+    cfg = RunConfig.from_dict({**run, **requested})
     fresh = policy_select.resolve(
         cfg,
         backend=event.get("backend"),
@@ -185,7 +191,23 @@ def policy_check(manifest_path: str, ledger_path: str) -> int:
     print(f"  current:  {fresh.label}  [{fresh.provenance}"
           + (f", {fresh.value:g} {fresh.unit}"
              if fresh.value is not None else "") + "]")
-    if fresh.label == recorded_label:
+    stale = fresh.label != recorded_label
+    if event.get("groups") is not None:
+        # a coupled winner can move WITHOUT moving the run label (mode
+        # tokens do not change it — only the |grp: signature): compare
+        # the resolved canonical spec, and name the group that moved
+        rec_groups = {d.get("group"): d for d in
+                      event.get("group_decisions") or []}
+        for d in fresh.group_decisions:
+            rec = rec_groups.get(d["group"]) or {}
+            moved = rec.get("clause") != d["clause"]
+            print(f"  group {d['group']}: recorded "
+                  f"{rec.get('clause')!r} [{rec.get('provenance')}] "
+                  f"-> current {d['clause']!r} [{d['provenance']}]"
+                  + ("  <-- MOVED" if moved else ""))
+            stale = stale or moved
+        stale = stale or fresh.groups != event["groups"]
+    if not stale:
         print("policy-check: OK — the recorded decision is still the "
               "ledger winner")
         return 0

@@ -643,6 +643,59 @@ print('groups smoke ok: 2 groups coupled, %.4f Mcells/s, rows=%s'
 " || rc=1
 timeout -k 10 120 python scripts/obs_report.py /tmp/_t1_groups.jsonl \
   --check > /dev/null || rc=1
+# Collective interface-transport smoke (round 23, ISSUE 19): the same
+# heterogeneous coupled run under --group-transport collective — the
+# interface bands ride ppermute rounds over the union device set, zero
+# host hops.  Pins (1) the transport jaxpr gate (no device_put anywhere,
+# exactly 2*interfaces ppermutes, nothing else collective), (2) the
+# manifest groups block carrying per-group transport + mode tokens,
+# (3) the costmodel<->budget pricing cross-check (bytes_per_round ==
+# the itemized per-direction budget parts, both transports), and (4) a
+# schema-valid log (obs_report --check below).
+rm -f /tmp/_t1_grpcoll.jsonl
+timeout -k 10 300 python -c "
+import json
+from cpuforce import force_cpu; force_cpu(8)
+from mpi_cuda_process_tpu import cli
+from mpi_cuda_process_tpu.obs import costmodel
+from mpi_cuda_process_tpu.parallel import groups as groups_lib
+from mpi_cuda_process_tpu.utils import budget, jaxprcheck
+gspec = 'wave3d:fine@0-3:z1/4:mesh1x4:overlap,heat3d:coarse@4-7:mesh1x4'
+rep = jaxprcheck.check_group_transport_structure(gspec, (24, 16, 16))
+assert rep['transport'] == 'collective', rep
+assert rep['n_ppermute'] == 2 and rep['n_device_put'] == 0, rep
+fields, mcells = cli.run(cli.config_from_args(
+    ['--stencil', 'wave3d', '--grid', '24,16,16', '--iters', '8',
+     '--groups', gspec, '--group-transport', 'collective',
+     '--log-every', '2', '--telemetry', '/tmp/_t1_grpcoll.jsonl']))
+assert fields[0].shape == (24, 16, 16) and mcells > 0
+recs = [json.loads(l) for l in open('/tmp/_t1_grpcoll.jsonl')
+        if l.strip()]
+man = next(r for r in recs if r.get('kind') == 'manifest')
+assert man['run'].get('group_transport') == 'collective', man['run']
+gb = man['groups']
+assert [g['transport'] for g in gb] == ['collective'] * 2, gb
+assert gb[0]['modes'] == ['overlap'] and gb[1]['modes'] == [], gb
+assert all('clause' in g for g in gb), gb
+plans = groups_lib.plans_from_config(gspec, (24, 16, 16), n_devices=8)
+for t in ('collective', 'device_put'):
+    c = costmodel.coupled_cost(plans, 1.2e12, 4.5e10, transport=t)['interface']
+    assert c['transport'] == t, c
+    _, per_group = budget.estimate_coupled_bytes(plans, transport=t)
+    parts = [p for _, _, ps in per_group for p in ps]
+    staged = sum(b for n, b in parts if 'raw staged rows' in n
+                 or 'staged send' in n)
+    assert staged == c['staged_bytes_per_round'], (t, staged, c)
+    wire = sum(b for n, b in parts if 'collective wire chunk' in n
+               or ('staged send' in n and t == 'device_put'))
+    recv = sum(b for n, b in parts if 'band recv' in n)
+    want = wire if t == 'collective' else recv
+    assert c['bytes_per_round'] == want, (t, c['bytes_per_round'], want)
+print('collective groups smoke ok: %d ppermutes, 0 device_put, '
+      '%.4f Mcells/s' % (rep['n_ppermute'], mcells))
+" || rc=1
+timeout -k 10 120 python scripts/obs_report.py /tmp/_t1_grpcoll.jsonl \
+  --check > /dev/null || rc=1
 # The committed campaign ledger must render in both one-command
 # summary surfaces: obs_report --ledger (best_known + quarantine
 # table) and the terminal monitor's ledger mode.

@@ -96,10 +96,12 @@ def test_tune_variant_is_the_campaign_label_contract():
 
 def test_registry_families_and_tiles():
     for v in autotune.VARIANTS.values():
-        assert v.family in ("rdma", "stream"), v
-        assert v.id in autotune.STREAM_SWEEP + autotune.RDMA_SWEEP
+        assert v.family in ("rdma", "stream", "tiled"), v
+        assert v.id in (autotune.STREAM_SWEEP + autotune.RDMA_SWEEP
+                        + autotune.TILED_SWEEP)
     assert autotune.VARIANTS["bz16y32"].tiles == (16, 32)
     assert autotune.VARIANTS["ring3"].tiles is None
+    assert autotune.VARIANTS["tz8y128"].tiles == (8, 128)
 
 
 # ------------------------------------------- validation: named reasons
@@ -115,6 +117,66 @@ def test_family_prerequisites_named(kw, fragment):
     ok, reason = autotune.validate_variant(
         autotune.VARIANTS["bz16y16"], cfg)
     assert not ok and fragment in reason, reason
+
+
+def _tiled_cfg(**kw):
+    """Unsharded tiled-window config — the tiled family's host."""
+    kw.setdefault("stencil", "heat3d")
+    kw.setdefault("grid", (32, 128, 128))
+    kw.setdefault("fuse", 4)
+    kw.setdefault("fuse_kind", "tiled")
+    kw.setdefault("iters", 2)
+    return RunConfig(**kw)
+
+
+def test_tune_variant_tiled_family():
+    """Round 23: the tiled sweep joins the tuneN label contract."""
+    assert autotune.tune_variant("tiled", 1).id == autotune.TILED_SWEEP[0]
+    assert autotune.tune_variant("tiled", 3).id == "tz128y32"
+    with pytest.raises(ValueError, match="swept variants"):
+        autotune.tune_variant("tiled", len(autotune.TILED_SWEEP) + 1)
+
+
+def test_tiled_family_prerequisites_named():
+    v = autotune.VARIANTS["tz8y128"]
+    # a tiled variant needs the tiled kind...
+    ok, why = autotune.validate_variant(
+        v, _tiled_cfg(fuse_kind="stream", mesh=(2, 1, 1)))
+    assert not ok and "--fuse-kind tiled" in why
+    # ...and no mesh (the padded window kernel is unsharded-only)
+    ok, why = autotune.validate_variant(v, _tiled_cfg(mesh=(2, 1, 1)))
+    assert not ok and "unsharded-only" in why
+    # and a stream variant cannot ride a tiled config
+    ok, why = autotune.validate_variant(autotune.VARIANTS["bz16y16"],
+                                        _tiled_cfg())
+    assert not ok and "--fuse-kind stream" in why
+
+
+def test_tiled_geometry_rejections_named():
+    v = autotune.VARIANTS["tz8y128"]
+    # non-dividing tiles
+    ok, why = autotune.validate_variant(autotune.VARIANTS["tz128y32"],
+                                        _tiled_cfg())
+    assert not ok and "does not divide Z" in why
+    # bf16 k=4: 2m=8 misses the 16-row sublane tile — named, no compile
+    ok, why = autotune.validate_variant(v, _tiled_cfg(dtype="bfloat16"))
+    assert not ok and "sublane" in why
+    # tiles not multiples of 2*margin (k=8 f32: 2m=16 rejects bz=8)
+    ok, why = autotune.validate_variant(
+        v, _tiled_cfg(fuse=8, grid=(32, 128, 128)))
+    assert not ok and "2*margin" in why
+    # VMEM overflow named from the _pick_tiles cost model, pre-compile
+    ok, why = autotune.validate_variant(
+        autotune.VARIANTS["tz32y128"], _tiled_cfg(grid=(32, 128, 2048)))
+    assert not ok and "VMEM overflow" in why
+
+
+def test_sweep_ids_tiled_config():
+    assert autotune.sweep_ids(_tiled_cfg()) == list(autotune.TILED_SWEEP)
+    # a sharded run never proposes the tiled family (maybe_autotune's
+    # prereq probe follows the config's own kind)
+    with pytest.raises(ValueError, match="drop --mesh"):
+        autotune.maybe_autotune(_tiled_cfg(mesh=(2, 1, 1)))
 
 
 def test_2d_grids_have_no_variants():
@@ -469,6 +531,37 @@ def test_stream_margin_order_variants_bit_exact():
     _assert_variants_bit_exact(_cfg(), ("orev",))
     _assert_variants_bit_exact(_cfg(grid=(96, 96, 128)),
                                ("mg16", "mg32"))
+
+
+def test_tiled_variant_bit_exact_unsharded_f32():
+    """A swept window tile computes the exact default-picker fields
+    through the full cli build (the rest of the tiled product is slow)."""
+    _assert_variants_bit_exact(_tiled_cfg(), ("tz8y128",))
+
+
+def test_candidates_extend_tiled_variants_unsharded():
+    """The policy enumeration proposes the tiled family for unsharded
+    tiled configs — same dimension the streaming mesh configs grew."""
+    cfg = _tiled_cfg()
+    locked = ps.locked_fields(cfg)
+    cands = ps.candidates(cfg, "cpu", locked, None, 2)
+    vids = {c.kernel_variant for c in cands}
+    assert {"tz8y128", "tz32y128"} <= vids, vids
+    # the infeasible tile is pruned by the _valid predicate, not listed
+    assert "tz128y32" not in vids  # bz=128 cannot divide Z=32
+
+
+@pytest.mark.slow
+def test_tiled_variants_bit_exact_matrix():
+    grid = (128, 128, 128)
+    _assert_variants_bit_exact(_tiled_cfg(grid=grid),
+                               autotune.TILED_SWEEP)
+    _assert_variants_bit_exact(_tiled_cfg(stencil="wave3d", grid=grid),
+                               autotune.TILED_SWEEP)
+    # bf16 hosts k=8 (2m=16): bz=8 drops out of the sweep by name
+    _assert_variants_bit_exact(
+        _tiled_cfg(dtype="bfloat16", fuse=8, grid=grid),
+        ("tz32y128", "tz128y32"))
 
 
 def test_rdma_variant_bit_exact_zonly_f32():

@@ -42,8 +42,12 @@ def test_labels_unique_and_risky_derived(M):
     # the risky set is positional: everything at/after the Tier-D marker
     start = labels.index(M._TIER_D_START)
     assert M._RISKY == frozenset(labels[start:])
-    # Tier D must be non-empty and must not swallow the safe tiers
-    assert 0 < len(M._RISKY) < len(labels) / 2
+    # Tier D must be non-empty and must not swallow the safe tiers.
+    # The risky tail has grown a sub-tier per perf round (D9..D15),
+    # so the bound is 2/3 rather than the original half — the safe
+    # jnp/raw/copy prefix must stay a substantial minority.
+    assert 0 < len(M._RISKY) < len(labels) * 2 / 3
+    assert start > 0
 
 
 def test_risky_labels_are_new_large_compiles(M):
