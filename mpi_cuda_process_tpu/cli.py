@@ -246,6 +246,38 @@ def build_parser() -> argparse.ArgumentParser:
                         "reason 'diverged', /status.json and obs_top "
                         "render it.  With no logging cadence a "
                         "~8-chunk boundary cadence is synthesized")
+    p.add_argument("--anomaly", action="store_true",
+                   help="run doctor (obs/anomaly.py): continuous "
+                        "performance-anomaly detection at chunk "
+                        "boundaries — same zero-ops-in-the-jitted-step "
+                        "discipline as --health, consuming only the "
+                        "chunk records the recorder already writes.  "
+                        "Flags throughput collapse vs the run's own "
+                        "rolling steady-state baseline AND vs the "
+                        "campaign ledger's best_known band, recompiles "
+                        "after chunk 0, device-memory creep, growing "
+                        "chunk-time variance, and straggler "
+                        "attribution naming the slowest host/group "
+                        "with its lag ratio.  Findings land as "
+                        "'anomaly' events and a DEGRADED verdict that "
+                        "flows everywhere WEDGED does (/status.json, "
+                        "obs_top, the engine, the supervisor via "
+                        "--degraded-action, ledger degraded=N flags, "
+                        "perf_gate) — but a slow run is not a dead "
+                        "run: nothing aborts unless you ask.  On a "
+                        "terminal verdict the session's flight "
+                        "recorder drops a self-contained post-mortem "
+                        "bundle next to the telemetry log "
+                        "(scripts/obs_bundle.py makes one on demand)")
+    p.add_argument("--degraded-action", default="warn",
+                   choices=["warn", "restart", "abort"],
+                   help="what --supervise does about a DEGRADED child "
+                        "(anomaly events in its telemetry): warn = log "
+                        "and keep watching (default — a slow run is "
+                        "not a dead run), restart = kill and resume "
+                        "from the latest checkpoint (transient host "
+                        "trouble), abort = give up immediately with "
+                        "the flight-recorder bundle")
     p.add_argument("--halo-audit", type=int, default=0, metavar="K",
                    help="opt-in exchange audit (obs/health.py), every "
                         "K chunks: re-exchange the ghost slabs "
@@ -479,6 +511,7 @@ def config_from_args(argv=None) -> RunConfig:
         tol=a.tol, tol_check_every=a.tol_check_every,
         check_finite=a.check_finite, debug_checks=a.debug_checks,
         health=a.health, halo_audit=a.halo_audit,
+        anomaly=a.anomaly, degraded_action=a.degraded_action,
         dump_every=a.dump_every, dump_dir=a.dump_dir,
         mem_check=a.mem_check,
         auto_policy=a.auto_policy, policy_recheck=a.policy_recheck,
@@ -1280,6 +1313,66 @@ def _open_serve(cfg: RunConfig, session):
         return None
 
 
+def _make_anomaly_monitor(cfg: RunConfig, session, cells: int):
+    """Run doctor (obs/anomaly.py) for ``--anomaly``: the chunk-boundary
+    detector, seeded with the campaign ledger's ``best_known`` row for
+    this label x backend so the roofline-gap band has a reference.  The
+    ledger lookup is best-effort (no ledger, no matching baseline key →
+    own-baseline detection only)."""
+    from .obs import anomaly as anomaly_lib
+
+    best = None
+    try:
+        from .obs import ledger as ledger_lib
+
+        rows = ledger_lib.read_rows(ledger_lib.default_ledger_path())
+        if rows:
+            run = dataclasses.asdict(cfg)
+            probe = ledger_lib.make_row(
+                ledger_lib._cli_label(run), 1.0, source="anomaly-probe",
+                expected_backend=jax.default_backend(),
+                flags=ledger_lib._flags(run) or None)
+            best = ledger_lib.best_known(rows).get(
+                ledger_lib.baseline_key(probe))
+    except Exception:  # noqa: BLE001 — the band is optional evidence
+        best = None
+    try:
+        import socket
+
+        ident = f"{socket.gethostname()}|p{int(jax.process_index())}"
+    except Exception:  # noqa: BLE001
+        ident = "?|p?"
+    return anomaly_lib.AnomalyMonitor(
+        trace=session.trace, spans=session.spans, ident=ident,
+        cells=cells, best_known=best)
+
+
+def _attach_anomaly(cfg: RunConfig, session, cells: int) -> None:
+    """Hang the run doctor off the session recorder (never load-bearing:
+    a construction failure leaves the run undoctored, not dead)."""
+    if not cfg.anomaly or session is None:
+        return
+    try:
+        session.recorder.anomaly = _make_anomaly_monitor(cfg, session, cells)
+    except Exception:  # noqa: BLE001
+        log.debug("--anomaly monitor construction failed; run proceeds "
+                  "undoctored", exc_info=True)
+
+
+def _maybe_bundle(session, reason: str, verdict=None) -> None:
+    """Terminal-verdict flight-recorder bundle (obs/flightrec.py).
+
+    Called on the paths where a run ends with something to explain —
+    an error/DIVERGED abort, or a clean exit that accumulated anomaly
+    findings.  ``bundle_from_session`` swallows every failure."""
+    from .obs import flightrec as flightrec_lib
+
+    path = flightrec_lib.bundle_from_session(session, reason,
+                                             verdict=verdict)
+    if path:
+        log.info("flight-recorder bundle: %s", path)
+
+
 def _run_once(cfg: RunConfig, decision=None) -> Tuple:
     if not cfg.telemetry:
         return _run_measured(cfg, None, decision=decision)
@@ -1297,7 +1390,13 @@ def _run_once(cfg: RunConfig, decision=None) -> Tuple:
             for gd in getattr(decision, "group_decisions", None) or []:
                 session.event("policy_group", **gd)
             session.event("policy", **decision.as_event())
-        return _run_measured(cfg, session, decision=decision)
+        result = _run_measured(cfg, session, decision=decision)
+        mon = getattr(session.recorder, "anomaly", None)
+        if mon is not None and mon.count:
+            # a run that finished slow finished DEGRADED: leave the
+            # post-mortem bundle even though nothing aborted
+            _maybe_bundle(session, "degraded", verdict="DEGRADED")
+        return result
     except cancellation.RunCancelled as e:
         # a cancel is a third terminal outcome, not an error: the log
         # records a 'cancelled' event (ledger quarantines with reason
@@ -1306,6 +1405,16 @@ def _run_once(cfg: RunConfig, decision=None) -> Tuple:
         raise
     except BaseException as e:
         session.error(e)
+        verdict = None
+        try:
+            from .obs import health as health_lib
+
+            if isinstance(e, health_lib.SimulationDiverged):
+                verdict = "DIVERGED"
+        except Exception:  # noqa: BLE001
+            pass
+        _maybe_bundle(session, f"error:{type(e).__name__}",
+                      verdict=verdict)
         raise
     finally:
         session.close()
@@ -1435,10 +1544,11 @@ def _run_coupled(cfg: RunConfig, session, decision=None) -> Tuple:
                              cfg.check_finite) if v]
     interval = math.gcd(*intervals) if len(intervals) > 1 else (
         intervals[0] if intervals else 0)
-    if cfg.health and not interval and remaining >= 2:
+    if (cfg.health or cfg.anomaly) and not interval and remaining >= 2:
         interval = max(1, remaining // 8)
 
     cells_round = runner.cell_updates_per_round()
+    _attach_anomaly(cfg, session, cells_round)
     done = 0
     chunk = 0
     t0 = time.perf_counter()
@@ -1446,7 +1556,18 @@ def _run_coupled(cfg: RunConfig, session, decision=None) -> Tuple:
         n = min(interval or remaining, remaining - done)
         tc = time.perf_counter()
         runner.run(n)
-        runner.block_until_ready()
+        # block per group IN ORDER and timestamp each ready horizon:
+        # the groups' device programs overlap on disjoint devices, so a
+        # group's horizon approximates its own duration (an early slow
+        # group masks later fast ones — the masked groups then read the
+        # same horizon, which the straggler detector's peer-median
+        # comparison treats as "no single suspect": conservative)
+        group_ready_ms = []
+        for fs in runner.fields:
+            for f in fs:
+                f.block_until_ready()
+            group_ready_ms.append(
+                round((time.perf_counter() - tc) * 1e3 / n, 6))
         dtc = time.perf_counter() - tc
         done += n
         step = start_round + done
@@ -1454,13 +1575,23 @@ def _run_coupled(cfg: RunConfig, session, decision=None) -> Tuple:
         faults.maybe_fire("exchange", step=step)
         if session is not None:
             session.recorder.record_chunk(n, dtc)
-            for p in plans:
+            for p, ready_ms in zip(plans, group_ready_ms):
                 session.event(
                     "group_chunk", step=step, group=p.name, op=p.spec.op,
                     ratio=p.ratio,
                     dtype=str(np.dtype(p.stencil.dtype)),
                     steps=n, wall_s=round(dtc, 4),
+                    ready_ms_per_step=ready_ms,
                     mcells_per_s=round(p.cells * n / dtc / 1e6, 3))
+            mon = getattr(session.recorder, "anomaly", None)
+            if mon is not None:
+                try:
+                    mon.observe_members(step, [
+                        {"name": p.name, "ms_per_step": ready_ms}
+                        for p, ready_ms in zip(plans, group_ready_ms)],
+                        kind="group")
+                except Exception:  # noqa: BLE001 — never load-bearing
+                    pass
         poison = faults.injected_numeric_poison(step)
         if poison is not None:
             from .obs import health as health_lib
@@ -1639,6 +1770,7 @@ def _run_measured(cfg: RunConfig, session, decision=None) -> Tuple:
             cfg.grid, exchange=cfg.exchange, periodic=cfg.periodic,
             ensemble=cfg.ensemble,
             trace=session.trace if session is not None else None)
+    _attach_anomaly(cfg, session, cells)
 
     if cfg.tol > 0:
         if cfg.log_every or cfg.checkpoint_every or \
@@ -1766,9 +1898,9 @@ def _run_measured(cfg: RunConfig, session, decision=None) -> Tuple:
                              cfg.dump_every if cfg.dump_dir else 0) if v]
     interval = math.gcd(*intervals) if len(intervals) > 1 else (
         intervals[0] if intervals else 0)
-    if (cfg.health or cfg.halo_audit) and not interval:
+    if (cfg.health or cfg.halo_audit or cfg.anomaly) and not interval:
         # no logging cadence: synthesize ~8 chunk boundaries so the
-        # sentinel/audit have boundaries to run at (the --profile
+        # sentinel/audit/doctor have boundaries to run at (the --profile
         # trick, coarser); multiples of the fused step unit so the
         # cadence accounting below holds unchanged
         unit = max(1, cfg.fuse)

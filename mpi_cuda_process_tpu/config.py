@@ -121,6 +121,20 @@ class RunConfig:
     # bit-compare every received slab against the neighbor interior it
     # must equal; 0 = off.  Needs a spatially sharded --mesh.
     halo_audit: int = 0
+    # run doctor (obs/anomaly.py): a chunk-boundary performance-anomaly
+    # detector — throughput collapse vs the run's own rolling baseline
+    # and the ledger's best_known band, post-warmup recompiles, memory
+    # creep, variance growth, straggler attribution — whose findings
+    # are 'anomaly' events and turn the run's verdict DEGRADED.  Host
+    # Python at chunk boundaries only: the step jaxpr is byte-identical
+    # on vs off (the --health invariant).
+    anomaly: bool = False
+    # supervisor policy for a DEGRADED child (resilience/supervisor.py):
+    # warn (default — a slow run is not a dead run; the verdict flows
+    # to /status.json and the ledger but nothing is killed) | restart
+    # (kill + resume-relaunch like WEDGED) | abort (give up like
+    # DIVERGED).  Parent-side only, like the other supervisor knobs.
+    degraded_action: str = "warn"
     tol: float = 0.0  # >0: stop when residual < tol (lax.while_loop runner)
     tol_check_every: int = 10  # residual check cadence for --tol
     dump_every: int = 0  # >0: async .npy snapshots of field0 every N steps
@@ -194,7 +208,8 @@ class RunConfig:
 # re-served would race the parent for the port.
 _ARGV_SKIP = frozenset({"supervise", "max_restarts", "restart_backoff",
                         "supervise_stall_s", "serve_port", "serve_engine",
-                        "serve_router", "router_replicas", "shrink_after"})
+                        "serve_router", "router_replicas", "shrink_after",
+                        "degraded_action"})
 
 
 # --------------------------------------------------------------------------
@@ -214,6 +229,7 @@ LIFECYCLE_FIELDS = frozenset({
     "log_every", "checkpoint_every", "checkpoint_dir",
     "checkpoint_backend", "resume", "render", "profile_dir", "profile",
     "check_finite", "debug_checks", "health", "halo_audit",
+    "anomaly", "degraded_action",
     "dump_every", "dump_dir",
     "telemetry", "mem_check", "supervise", "max_restarts",
     "restart_backoff", "supervise_stall_s", "serve_port",

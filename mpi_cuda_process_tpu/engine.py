@@ -202,6 +202,21 @@ class RunHandle:
         after) — the eviction signal, without the full status walk."""
         return (self.status().get("health") or {}).get("verdict")
 
+    def anomalies(self) -> List[Dict[str, Any]]:
+        """Run-doctor findings for this run (obs/anomaly.py ``anomaly``
+        records from the telemetry stream, oldest first; empty when the
+        run is clean or ``--anomaly`` was off).  A non-empty list means
+        :meth:`status`'s verdict reads DEGRADED unless something worse
+        (WEDGED/DIVERGED) dominates — degraded runs are NOT evicted;
+        the findings are the attribution a caller acts on."""
+        out = []
+        for rec in self.events():
+            if rec.get("kind") == "anomaly":
+                rec = dict(rec)
+                rec.pop("_seq", None)
+                out.append(rec)
+        return out
+
 
 class SimulationEngine:
     """Async request front-end: ``submit(cfg) -> RunHandle``.

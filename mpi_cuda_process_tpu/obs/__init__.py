@@ -37,6 +37,11 @@ validator is the single definition) and the same event vocabulary:
 * ``router``     — one fleet-router decision (``serving/router.py``:
   route/rebalance/reject/replica_up/replica_dead, with replica
   liveness and in-flight gauges riding every record)
+* ``anomaly``    — one run-doctor finding (``anomaly.py``: throughput
+  collapse vs own baseline or the ledger roofline band, post-warmup
+  recompiles, device-memory creep, chunk-time variance growth,
+  straggler attribution naming the slowest host/group with its lag
+  ratio — the evidence behind the DEGRADED verdict)
 * ``error`` / ``summary`` — how the run ended
 
 Sibling stores complete the layer: ``profile.py`` wraps a
@@ -63,6 +68,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
+from . import flightrec as flightrec_lib
 from . import heartbeat as heartbeat_lib
 from . import runtime as runtime_lib
 from . import spans as spans_lib
@@ -86,11 +92,16 @@ class Session:
     def __init__(self, trace: trace_lib.TraceWriter,
                  recorder: runtime_lib.RuntimeRecorder,
                  heartbeat: Optional[heartbeat_lib.Heartbeat],
-                 spans: Optional[spans_lib.SpanEmitter] = None):
+                 spans: Optional[spans_lib.SpanEmitter] = None,
+                 flight: Optional[flightrec_lib.FlightRecorder] = None):
         self.trace = trace
         self.recorder = recorder
         self.heartbeat = heartbeat
         self.spans = spans
+        # the post-mortem ring (obs/flightrec.py): mirrors every trace
+        # record in memory so a terminal verdict can emit a bundle even
+        # after the telemetry dir is gone
+        self.flight = flight
         self._finished = False
 
     @property
@@ -161,6 +172,8 @@ def open_session(
     manifest carries the ``trace`` identity block either way.
     """
     trace = trace_lib.TraceWriter(path)
+    flight = flightrec_lib.FlightRecorder()
+    trace.mirrors.append(flight.note)
     spans = spans_lib.SpanEmitter(trace, context=spans_lib.resolve_context(),
                                   root_name=tool)
     manifest_extra.setdefault("trace", spans.manifest_block())
@@ -173,7 +186,7 @@ def open_session(
         hb = heartbeat_lib.Heartbeat(recorder, trace=trace,
                                      stall_after_s=stall_after_s)
         hb.start()
-    return Session(trace, recorder, hb, spans=spans)
+    return Session(trace, recorder, hb, spans=spans, flight=flight)
 
 
 __all__ = ["Session", "open_session"]

@@ -155,7 +155,7 @@ class HostAggregator:
             "resumed_from_step": st.get("resumed_from_step"),
             "give_up": bool(st.get("give_up")),
         }
-        for opt in ("trace_id", "time_to_first_chunk_s"):
+        for opt in ("trace_id", "time_to_first_chunk_s", "anomalies"):
             if st.get(opt) is not None:
                 row[opt] = st[opt]
         if meta.get("replica"):
@@ -179,8 +179,10 @@ class HostAggregator:
         if any(r.get("give_up") for r in rows):
             worst = "GAVE_UP"
         # DIVERGED outranks liveness trouble: a host that is provably
-        # computing garbage is worse than one that is merely stuck
-        for v in ("DIVERGED", "WEDGED", "STALLED"):
+        # computing garbage is worse than one that is merely stuck —
+        # and anything stuck outranks DEGRADED, which is still making
+        # progress (a slow run is not a dead run)
+        for v in ("DIVERGED", "WEDGED", "STALLED", "DEGRADED"):
             if v in verdicts:
                 worst = v
                 break
@@ -203,7 +205,32 @@ class HostAggregator:
             "trace_ids": sorted({r["trace_id"] for r in rows
                                  if r.get("trace_id")}),
         }
+        anomalies = sum((r.get("anomalies") or {}).get("count") or 0
+                        for r in rows)
+        if anomalies:
+            agg["anomalies"] = anomalies
+        # fleet straggler attribution: per-host ms/step from the latest
+        # chunk is the homogeneous slowness signal (every process slot
+        # runs the same program in an SPMD fleet), so the peer-median
+        # comparison in obs/anomaly.py applies directly
+        suspect = self._straggler(rows)
+        if suspect is not None:
+            agg["straggler"] = suspect
         return {"hosts": rows, "aggregate": agg}
+
+    @staticmethod
+    def _straggler(rows) -> Optional[Dict[str, Any]]:
+        from . import anomaly as anomaly_lib
+        entries = []
+        for r in rows:
+            chunk = r.get("latest_chunk") or {}
+            ms = chunk.get("ms_per_step")
+            if isinstance(ms, (int, float)) and ms > 0:
+                entries.append({"name": r["key"], "slowness": float(ms)})
+        try:
+            return anomaly_lib.attribute_straggler(entries, kind="host")
+        except Exception:  # noqa: BLE001 — diagnosis is best-effort
+            return None
 
 
 def aggregate_logs(paths: Iterable[str]) -> Dict[str, Any]:

@@ -578,12 +578,24 @@ def rows_from_log(log_path: str) -> List[Dict[str, Any]]:
             cancelled_ev = e
     if tool == "cli":
         summaries = [e for e in events if e.get("kind") == "summary"]
+        # run-doctor findings (obs/anomaly.py, --anomaly): a DEGRADED
+        # run's value is honest — the steps ran, the number is real —
+        # so the row is NOT quarantined, just flagged.  perf_gate
+        # renders the flag as [degraded]; obs_report shows the
+        # findings.  Clean runs (N == 0) add no detail key, so every
+        # pre-existing row stays byte-identical.
+        n_anomalies = sum(1 for e in events if e.get("kind") == "anomaly")
         if run.get("groups"):
             # per-group rows land ALONGSIDE the coupled headline row —
             # the policy resolver reads these, the perf gate the main
             rows.extend(_group_rows(manifest, events, run, prov,
                                     source, hb, health))
         for s in summaries:
+            detail = {}
+            if resumed_from is not None:
+                detail["resumed_from_step"] = resumed_from
+            if n_anomalies:
+                detail["degraded"] = n_anomalies
             rows.append(make_row(
                 _cli_label(run), s.get("mcells_per_s"), source=source,
                 measured_at=s.get("t"), heartbeat=hb, health=health,
@@ -592,8 +604,7 @@ def rows_from_log(log_path: str) -> List[Dict[str, Any]]:
                 grid=run.get("grid"), mesh=run.get("mesh"),
                 kind=run.get("fuse_kind"), dtype=run.get("dtype"),
                 flags=_flags(run), builder_rev=prov.get("builder_rev"),
-                detail={"resumed_from_step": resumed_from}
-                if resumed_from is not None else None))
+                detail=detail or None))
         if cancelled_ev is not None and not summaries:
             # a cancelled run ends before its summary — the row still
             # lands (value-less, quarantined 'cancelled') so the ledger

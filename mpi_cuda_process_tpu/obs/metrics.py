@@ -351,6 +351,12 @@ class RunMetrics:
         # fast, alive, WRONG run must never read as healthy)
         self.health: Optional[Dict[str, Any]] = None
         self.halo_audit: Optional[Dict[str, Any]] = None
+        # run doctor (obs/anomaly.py): recent performance findings —
+        # any finding turns the status verdict DEGRADED (dominated by
+        # every harder verdict: a slow run is not a dead run)
+        self.anomalies: Deque[Dict[str, Any]] = collections.deque(maxlen=32)
+        self.anomalies_total = 0
+        self.anomaly_kinds: Dict[str, int] = {}
         self.summary: Optional[Dict[str, Any]] = None
         # cooperative cancel (cancellation.py): a third terminal state
         # — neither summary nor error; the status verdict reports it
@@ -651,6 +657,36 @@ class RunMetrics:
                 "obs_health_worst_field_drift",
                 "worst per-field mean drift vs the chunk-0 baseline "
                 "(informational)").set(wf["drift"])
+
+    def _on_anomaly(self, rec: Dict[str, Any]) -> None:
+        """Fold one run-doctor finding (obs/anomaly.py): counted per
+        kind, the suspect kept whole — /status.json must NAME the slow
+        (host | group | member), not just count findings."""
+        self.anomalies.append(rec)
+        self.anomalies_total += 1
+        kind = str(rec.get("anomaly") or "unknown")
+        self.anomaly_kinds[kind] = self.anomaly_kinds.get(kind, 0) + 1
+        self.registry.counter("obs_anomalies_total",
+                              "run-doctor findings ingested").inc()
+        self.registry.counter(
+            f"obs_anomaly_{_prom_name(kind)}_total",
+            f"'{kind}' anomaly findings").inc()
+        self.registry.gauge(
+            "obs_degraded",
+            "1 once any performance anomaly was flagged").set(1.0)
+        suspect = rec.get("suspect") or {}
+        if isinstance(suspect, dict) and suspect.get("name"):
+            self.registry.info(
+                "obs_anomaly_suspect",
+                "latest straggler/collapse attribution").set(
+                kind=suspect.get("kind"), name=suspect.get("name"),
+                lag_ratio=suspect.get("lag_ratio"), anomaly=kind)
+        ratio = (rec.get("evidence") or {}).get("ratio")
+        if isinstance(ratio, (int, float)):
+            self.registry.gauge(
+                "obs_anomaly_collapse_ratio",
+                "latest ms/step over the run's own steady baseline").set(
+                ratio)
 
     def _on_halo_audit(self, rec: Dict[str, Any]) -> None:
         self.halo_audit = rec
@@ -953,6 +989,13 @@ class RunMetrics:
                 # is lost no matter what the heartbeat says (coupled
                 # runs: ANY group's divergence is the run's)
                 verdict = "DIVERGED"
+            if verdict is None and self.anomalies:
+                # performance findings degrade the verdict only when
+                # nothing harder (heartbeat/cancel/diverge) claimed it
+                # — and they outrank DONE: a run that finished slow
+                # finished DEGRADED, so obs_top --once still exits
+                # nonzero after the fact
+                verdict = "DEGRADED"
             out: Dict[str, Any] = {
                 "generated_at": time.time(),
                 "manifest": self.manifest,
@@ -986,6 +1029,14 @@ class RunMetrics:
                     key=lambda v: rank.get(v, 3), default=None)
                 out["groups"] = {"n_groups": len(rows), "rows": rows,
                                  "worst_verdict": worst}
+            if self.anomalies:
+                last = self.anomalies[-1]
+                out["anomalies"] = {
+                    "count": self.anomalies_total,
+                    "kinds": dict(self.anomaly_kinds),
+                    "last": last,
+                    "suspect": last.get("suspect"),
+                }
             if self.halo_audit is not None:
                 out["halo_audit"] = self.halo_audit
             if self.cancelled is not None:

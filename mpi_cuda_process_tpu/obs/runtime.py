@@ -115,6 +115,11 @@ class RuntimeRecorder:
         # division away — obs/metrics.RunMetrics does it)
         self.ensemble = max(0, int(ensemble))
         self.chunks: List[Dict[str, Any]] = []
+        # run doctor (obs/anomaly.AnomalyMonitor, optional): consumes
+        # each finished chunk record at the boundary the driver already
+        # crossed — the zero-ops-in-the-jitted-step invariant extends to
+        # the detector because it never sees anything but this dict
+        self.anomaly = None
         self.recompiles = 0
         self.last_progress = time.monotonic()
         self._chunk_begin_compiles: Optional[int] = None
@@ -175,6 +180,11 @@ class RuntimeRecorder:
         self.chunks.append(rec)
         if self.trace is not None:
             self.trace.event("chunk", **rec)
+        if self.anomaly is not None:
+            try:
+                self.anomaly.observe_chunk(rec)
+            except Exception:  # noqa: BLE001 — diagnosis never kills the run
+                pass
         if n == 0 and self.spans is not None:
             self.spans.emit("compile", time.time() - float(seconds),
                             float(seconds), steps=real_steps,

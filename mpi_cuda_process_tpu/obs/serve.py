@@ -251,7 +251,8 @@ class _Handler(BaseHTTPRequestHandler):
                             "obs live console\n"
                             "  /metrics      Prometheus text\n"
                             "  /status.json  provenance + latest chunk + "
-                            "heartbeat + restart trail\n"
+                            "heartbeat + restart trail (verdict DEGRADED "
+                            "= run-doctor anomaly findings)\n"
                             "  /events?after=SEQ&wait=S  incremental "
                             "NDJSON tail (bounded long-poll)\n",
                             "text/plain; charset=utf-8")

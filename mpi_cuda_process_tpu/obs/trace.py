@@ -259,6 +259,12 @@ class TraceWriter:
         self._lock = threading.Lock()
         self._wrote_manifest = False
         self.last_event_t = time.monotonic()
+        # in-process taps: each callable sees every record (manifest and
+        # events, heartbeat thread included) right after it hits disk.
+        # The flight recorder (obs/flightrec.py) rides here so its ring
+        # holds exactly what the log holds, without tailing our own file.
+        # A mirror raising must never kill the write path.
+        self.mirrors: List[Any] = []
 
     def write_manifest(self, manifest: Dict[str, Any]) -> None:
         validate_manifest(manifest)
@@ -284,6 +290,11 @@ class TraceWriter:
         self._fh.write(json.dumps(rec, default=str) + "\n")
         self._fh.flush()
         self.last_event_t = time.monotonic()
+        for mirror in self.mirrors:
+            try:
+                mirror(rec)
+            except Exception:  # noqa: BLE001 — taps are never load-bearing
+                pass
 
     def close(self) -> None:
         with self._lock:

@@ -59,7 +59,12 @@ Actions: ``sigkill`` (SIGKILL self — a real crash: no atexit, no
 flush), ``hang`` (stop making progress; capped at ``FAULT_HANG_S``,
 default 3600 s, so an orphaned child cannot outlive a dead supervisor
 forever), ``raise`` (raise :class:`FaultInjected`), ``wedge``
-(heartbeat site only).  Every spec fires at most once per process.
+(heartbeat site only), ``sleep:MS`` (stall the boundary for MS
+milliseconds then RETURN — a deterministic slowdown, not a death:
+the test seam for the performance-anomaly detector (obs/anomaly.py),
+mirroring how ``numerics:nan`` seeds ``--health``; valid at the
+exchange/checkpoint/label sites only).  Every spec fires at most once
+per process.
 
 Pure stdlib, no jax: importable from anywhere in the package without
 dragging a backend in, and a malformed spec raises loudly at the first
@@ -82,8 +87,11 @@ HANG_CAP_VAR = "FAULT_HANG_S"
 
 _SITES = ("exchange", "checkpoint", "compile", "label", "heartbeat",
           "numerics")
-_ACTIONS = ("sigkill", "hang", "raise", "wedge", "nan")
+_ACTIONS = ("sigkill", "hang", "raise", "wedge", "nan", "sleep")
 _PHASES = ("before_write", "during_write")
+# sleep is a SLOWDOWN, not a death: it only makes sense at sites the
+# run returns from (the anomaly detector's test seam — obs/anomaly.py)
+_SLEEP_SITES = ("exchange", "checkpoint", "label")
 
 
 class FaultInjected(RuntimeError):
@@ -99,6 +107,7 @@ class FaultSpec:
     name: Optional[str] = None
     attempt: int = 0
     always: bool = False
+    sleep_ms: Optional[int] = None
     raw: str = ""
 
 
@@ -111,6 +120,15 @@ def parse_specs(text: str) -> List[FaultSpec]:
             raise ValueError(
                 f"fault spec {raw!r}: want site[:qualifier]*:action")
         site, action = parts[0], parts[-1]
+        quals = parts[1:-1]
+        # sleep carries its duration as a trailing field: ``…:sleep:MS``
+        # (the one action with an operand, so the grammar stays
+        # site[:qual]*:action for everything else)
+        sleep_ms: Optional[int] = None
+        if len(parts) >= 3 and parts[-2] == "sleep" and \
+                parts[-1].isdigit():
+            action, sleep_ms = "sleep", int(parts[-1])
+            quals = parts[1:-2]
         if site not in _SITES:
             raise ValueError(f"fault spec {raw!r}: unknown site {site!r} "
                              f"(one of {_SITES})")
@@ -123,8 +141,18 @@ def parse_specs(text: str) -> List[FaultSpec]:
         if (action == "nan") != (site == "numerics"):
             raise ValueError(f"fault spec {raw!r}: 'nan' is the "
                              "numerics site's action (and its only one)")
+        if action == "sleep":
+            if sleep_ms is None or sleep_ms <= 0:
+                raise ValueError(
+                    f"fault spec {raw!r}: 'sleep' wants a positive "
+                    "duration — site[:qual]*:sleep:MS")
+            if site not in _SLEEP_SITES:
+                raise ValueError(
+                    f"fault spec {raw!r}: 'sleep' fires only at "
+                    f"{_SLEEP_SITES} (a slowdown needs a site the run "
+                    "returns from)")
         kw: Dict[str, object] = {}
-        for q in parts[1:-1]:
+        for q in quals:
             if q == "always":
                 kw["always"] = True
             elif q in _PHASES:
@@ -140,7 +168,8 @@ def parse_specs(text: str) -> List[FaultSpec]:
                     f"fault spec {raw!r}: unknown qualifier {q!r} (want "
                     "step=N, name=STR, attempt=N, always, "
                     f"{' or '.join(_PHASES)})")
-        specs.append(FaultSpec(site=site, action=action, raw=raw, **kw))
+        specs.append(FaultSpec(site=site, action=action, raw=raw,
+                               sleep_ms=sleep_ms, **kw))
     return specs
 
 
@@ -204,6 +233,13 @@ def _trigger(spec: FaultSpec) -> None:
         # would fake a RECOVERED verdict)
         os.kill(os.getpid(), signal.SIGKILL)
         os._exit(137)
+    if spec.action == "sleep":
+        # a slowdown, not a death: stall the boundary then RETURN —
+        # the deterministic stand-in for a straggler host / co-tenant
+        # squeeze that obs/anomaly.py must flag (its test seam, the
+        # way numerics:nan seeds --health)
+        time.sleep((spec.sleep_ms or 0) / 1000.0)
+        return
     if spec.action == "raise":
         raise FaultInjected(f"injected fault: {spec.raw}")
 

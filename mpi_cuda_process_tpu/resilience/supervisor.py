@@ -208,12 +208,28 @@ def spawn_child(cmd: Sequence[str], *, attempt: int,
 
 # --------------------------------------------------------------- watch
 
-def _classify_event(e, kill_verdicts, fatal_verdicts):
+def _classify_event(e, kill_verdicts, fatal_verdicts,
+                    degraded_action: str = "warn"):
     """One tailed record -> ("verdict"|"fatal", value, detail) or None."""
     if e.get("kind") == "heartbeat" and e.get("verdict") in kill_verdicts:
         return ("verdict", e.get("verdict"), str(e.get("detail", ""))[:300])
     if e.get("kind") == "health" and e.get("verdict") in fatal_verdicts:
         return ("fatal", e.get("verdict"), str(e.get("reason", ""))[:300])
+    if e.get("kind") == "anomaly":
+        # run-doctor finding (obs/anomaly.py): the child is SLOW, not
+        # dead.  The default is warn-only — a degraded run still makes
+        # progress, and killing it trades real work for a maybe.
+        # restart treats it as transient host trouble (checkpoint-
+        # resume, same as a wedge); abort gives up with the evidence.
+        suspect = e.get("suspect") or {}
+        detail = (f"{e.get('anomaly')} "
+                  f"(suspect {suspect.get('kind')}:{suspect.get('name')})"
+                  )[:300]
+        if degraded_action == "restart":
+            return ("verdict", "DEGRADED", detail)
+        if degraded_action == "abort":
+            return ("fatal", "DEGRADED", detail)
+        return None
     if e.get("kind") == "cancelled":
         # cooperative cancel (cancellation.py): a deliberately stopped
         # child is not a crash — never restart it into the work someone
@@ -226,6 +242,7 @@ def watch_child(handle, tails, *, stall_timeout_s: float,
                 poll_s: float = 0.5,
                 kill_verdicts: Sequence[str] = KILL_VERDICTS,
                 fatal_verdicts: Sequence[str] = FATAL_VERDICTS,
+                degraded_action: str = "warn",
                 clock: Callable[[], float] = time.monotonic,
                 sleep: Callable[[float], None] = time.sleep,
                 ) -> Tuple[str, Optional[Any], Optional[str]]:
@@ -251,7 +268,8 @@ def watch_child(handle, tails, *, stall_timeout_s: float,
         if events:
             last_event = clock()
             for e in events:
-                hit = _classify_event(e, kill_verdicts, fatal_verdicts)
+                hit = _classify_event(e, kill_verdicts, fatal_verdicts,
+                                      degraded_action)
                 if hit is not None:
                     return hit
         rc = handle.poll()
@@ -262,7 +280,8 @@ def watch_child(handle, tails, *, stall_timeout_s: float,
             # over the bare exit code — the rc is a symptom, the
             # DIVERGED record is the diagnosis.
             for e in (e for t in tails for e in t.poll()):
-                hit = _classify_event(e, kill_verdicts, fatal_verdicts)
+                hit = _classify_event(e, kill_verdicts, fatal_verdicts,
+                                      degraded_action)
                 if hit is not None:
                     return hit
             return ("exit", int(rc), None)
@@ -282,6 +301,7 @@ def supervise(launcher, checkpoint_dir: Optional[str], *,
               poll_s: float = 0.5,
               kill_verdicts: Sequence[str] = KILL_VERDICTS,
               fatal_verdicts: Sequence[str] = FATAL_VERDICTS,
+              degraded_action: str = "warn",
               session=None,
               sleep: Callable[[float], None] = time.sleep,
               clock: Callable[[], float] = time.monotonic,
@@ -310,6 +330,37 @@ def supervise(launcher, checkpoint_dir: Optional[str], *,
             except Exception:  # noqa: BLE001 — telemetry never load-bearing
                 pass
 
+    last_tails: List[Any] = []
+
+    def _give_up_bundle(reason: str, verdict: Optional[str]) -> None:
+        """Flight-recorder bundle at give-up (obs/flightrec.py): the
+        supervisor's own ring (launch/restart/give_up trail) plus the
+        tail of the final attempt's child log — the child was just
+        SIGKILLed, so its log on disk is all the evidence there is.
+        Best-effort on every path; a fake session in tests simply
+        yields no bundle."""
+        if session is None:
+            return
+        try:
+            from ..obs import aggregate as aggregate_lib
+            from ..obs import flightrec as flightrec_lib
+
+            extra: Dict[str, List[Dict[str, Any]]] = {}
+            for t in last_tails:
+                p = getattr(t, "path", None)
+                if not p:
+                    continue
+                recs = list(aggregate_lib.iter_records(p))[-80:]
+                extra[os.path.basename(p)] = [
+                    r for r in recs if r.get("kind") != "manifest"]
+            path = flightrec_lib.bundle_from_session(
+                session, reason, verdict=verdict,
+                extra_events=extra or None)
+            if path:
+                _event("bundle", path=path, reason=reason)
+        except Exception:  # noqa: BLE001 — post-mortems never load-bearing
+            pass
+
     # span emitter (obs/spans.py): the supervisor owns the RUN-LEVEL
     # trace — every attempt is an "attempt" span, every kill/backoff a
     # span between them, and the launcher exports OBS_TRACE_CONTEXT (via
@@ -333,10 +384,12 @@ def supervise(launcher, checkpoint_dir: Optional[str], *,
         with _span("attempt", attempt=attempt, resume=resume,
                    resumed_from_step=resumed_from):
             handle, tails = launcher(attempt, resume)
+            last_tails[:] = list(tails)
             outcome, value, detail = watch_child(
                 handle, tails, stall_timeout_s=stall_timeout_s,
                 poll_s=poll_s, kill_verdicts=kill_verdicts,
-                fatal_verdicts=fatal_verdicts, clock=clock,
+                fatal_verdicts=fatal_verdicts,
+                degraded_action=degraded_action, clock=clock,
                 sleep=sleep)
             if outcome != "exit":
                 # verdict/fatal/stall: the child is alive but lost —
@@ -352,8 +405,12 @@ def supervise(launcher, checkpoint_dir: Optional[str], *,
             # non-restartable: give up WITH the verdict, zero restarts
             # spent on a deterministic blow-up (the DIVERGED contract)
             reason = f"health verdict {value} (non-restartable)"
+            if value == "DEGRADED":
+                reason = "degraded (anomaly findings, " \
+                         "--degraded-action abort)"
             _event("give_up", attempts=attempt + 1, reason=reason,
                    detail=detail, verdict=value, restarts=len(restarts))
+            _give_up_bundle(reason, value)
             _event("summary", ok=False, attempts=attempt + 1,
                    restarts=len(restarts), gave_up=True, verdict=value)
             return SuperviseResult(
@@ -371,11 +428,15 @@ def supervise(launcher, checkpoint_dir: Optional[str], *,
                 checkpoint_dir=checkpoint_dir,
                 telemetry=getattr(session, "path", None))
         reason = {"exit": f"child exited rc={value}",
-                  "verdict": f"heartbeat verdict {value}",
+                  "verdict": ("degraded child (anomaly findings, "
+                              "--degraded-action restart)"
+                              if value == "DEGRADED"
+                              else f"heartbeat verdict {value}"),
                   "stall": "wall-clock stall"}[outcome]
         if attempt >= max_restarts:
             _event("give_up", attempts=attempt + 1, reason=reason,
                    detail=detail, restarts=len(restarts))
+            _give_up_bundle(reason, value if outcome == "verdict" else None)
             _event("summary", ok=False, attempts=attempt + 1,
                    restarts=len(restarts), gave_up=True)
             return SuperviseResult(
@@ -511,6 +572,7 @@ def run_supervised(cfg) -> int:
             max_restarts=cfg.max_restarts,
             backoff_base_s=cfg.restart_backoff,
             stall_timeout_s=cfg.supervise_stall_s,
+            degraded_action=getattr(cfg, "degraded_action", "warn"),
             session=session)
     finally:
         if session is not None:

@@ -472,7 +472,12 @@ class ResidentClass:
         """PR 12's DIVERGED verdict as the eviction signal: the job's
         log gets a real ``health`` record (so ``health_verdict()`` and
         ``/status.json`` read DIVERGED), the slot is scrubbed and
-        recycled, the other tenants never see the poison."""
+        recycled, the other tenants never see the poison.
+
+        Eviction stays DIVERGED-only by design: a DEGRADED job
+        (run-doctor anomaly findings) is slow, not poisoned — it keeps
+        its slot, keeps making progress, and carries its findings in
+        its own status for the caller to act on."""
         from ..obs.health import SimulationDiverged
 
         i = j.slot
@@ -928,6 +933,24 @@ class ServingEngine:
                              "size_class": j.class_label,
                              "priced_bytes": est["total_bytes"],
                              "hbm_bytes": est["hbm_bytes"]})
+                if getattr(cfg, "anomaly", False):
+                    # run doctor per job (obs/anomaly.py): the class
+                    # round loop already calls record_chunk on this
+                    # recorder, so attaching the monitor is the whole
+                    # wiring — findings land in the job's own log and
+                    # its status() reads DEGRADED.  A degraded job is
+                    # NEVER evicted (eviction stays DIVERGED-only:
+                    # slow is not poisoned).
+                    try:
+                        from ..obs import anomaly as anomaly_lib
+
+                        j.session.recorder.anomaly = \
+                            anomaly_lib.AnomalyMonitor(
+                                trace=j.session.trace,
+                                spans=j.session.spans,
+                                ident=j.id, cells=j.cells)
+                    except Exception:  # noqa: BLE001 — never load-bearing
+                        pass
             else:
                 j.session = _NullSession()
             if decision is not None:

@@ -29,6 +29,19 @@ is the campaign-state mode: the ``best_known`` table per label x
 backend plus quarantine counts and reasons, straight from
 ``benchmarks/ledger.jsonl`` (or a path you pass).
 
+Group-mode logs (``--groups``, PR 18/19) get per-group blocks:
+``policy_group`` clause decisions, per-group chunk rates with the
+coupled ready-horizon ms/step, ``migrate`` events, and group-named
+health verdicts.  ``anomaly`` events (the ``--anomaly`` run doctor)
+render as a findings table — their presence means verdict DEGRADED.
+
+When PATH is a flight-recorder bundle (``*.bundle.json``, written by
+obs/flightrec.py on a terminal verdict or by ``scripts/obs_bundle.py``)
+it is rendered as a self-contained post-mortem — manifest, event ring,
+anomaly findings, open spans, ledger baseline, tunnel verdict — with
+no need for the original telemetry dir.  ``--check`` on a bundle runs
+the bundle's own self-validation instead of the log schema walk.
+
 Safe on a wedged box: the CPU backend is forced before any jax use and
 nothing here touches a device.
 
@@ -39,6 +52,7 @@ Usage:  python scripts/obs_report.py PATH [--check]
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -249,6 +263,85 @@ def _supervisor_trail_block(events) -> str:
         rows, ["t", "attempt", "event", "ckpt/resume step", "detail"])
 
 
+def _policy_groups_block(evs) -> str:
+    """Per-group policy resolutions (``policy_group`` events, PR 19)."""
+    rows = []
+    for e in evs[:64]:
+        rows.append([e.get("group") or "?",
+                     (e.get("clause") or "")[:36],
+                     "locked" if e.get("locked") else "resolved",
+                     e.get("provenance") or "?",
+                     e.get("value") if e.get("value") is not None
+                     else "-"])
+    return "per-group policy decisions:\n" + _table(
+        rows, ["group", "clause", "how", "provenance", "Mcells/s"])
+
+
+def _group_chunks_block(evs) -> str:
+    """Coupled-run per-group throughput (``group_chunk`` events)."""
+    by_group: dict = {}
+    for e in evs:
+        by_group.setdefault(e.get("group") or "?", []).append(e)
+    rows = []
+    for g in sorted(by_group):
+        recs = by_group[g]
+        last = recs[-1]
+        vals = [r.get("mcells_per_s") for r in recs
+                if isinstance(r.get("mcells_per_s"), (int, float))]
+        ready = [r.get("ready_ms_per_step") for r in recs
+                 if isinstance(r.get("ready_ms_per_step"), (int, float))]
+        rows.append([
+            g, last.get("op") or "-", len(recs),
+            round(sum(vals) / len(vals), 3) if vals else "-",
+            last.get("mcells_per_s") if last.get("mcells_per_s")
+            is not None else "-",
+            round(sum(ready) / len(ready), 3) if ready else "-"])
+    return (f"coupled groups ({len(by_group)}):\n"
+            + _table(rows, ["group", "op", "chunks", "mean Mc/s",
+                            "last Mc/s", "ready ms/step"]))
+
+
+def _migrate_block(evs) -> str:
+    """Live-migration trail (``migrate`` events: policy adoptions)."""
+    rows = []
+    for e in evs[:64]:
+        dst = e.get("dst") or {}
+        mesh = dst.get("mesh")
+        rows.append([e.get("step", "-"), e.get("n", "-"),
+                     (e.get("label") or "?")[:36],
+                     e.get("provenance") or "?",
+                     "x".join(map(str, mesh)) if mesh else "-",
+                     e.get("rounds", "-")])
+    return "migrations:\n" + _table(
+        rows, ["step", "n", "label", "provenance", "dst mesh", "rounds"])
+
+
+def _group_health_block(evs) -> str:
+    """Group-named numerics verdicts of a coupled ``--health`` run."""
+    rows = [[f"{e['t']:.0f}", e.get("group") or "-", e.get("step", "-"),
+             e.get("verdict"), (e.get("reason") or "")[:56]]
+            for e in evs[:128]]
+    return "group health verdicts:\n" + _table(
+        rows, ["t", "group", "step", "verdict", "reason"])
+
+
+def _anomaly_block(evs) -> str:
+    """Run-doctor findings (``anomaly`` events, obs/anomaly.py)."""
+    rows = []
+    for e in evs[:200]:
+        sus = e.get("suspect") or {}
+        who = f"{sus.get('kind', '-')}:{sus.get('name', '-')}"
+        if sus.get("lag_ratio"):
+            who += f" x{sus['lag_ratio']}"
+        rows.append([e.get("chunk", "-"), e.get("anomaly") or "?",
+                     e.get("severity") or "?", who,
+                     json.dumps(e.get("evidence") or {},
+                                sort_keys=True)[:64]])
+    return (f"run-doctor findings ({len(evs)}) — verdict DEGRADED:\n"
+            + _table(rows, ["chunk", "anomaly", "severity", "suspect",
+                            "evidence"]))
+
+
 def render(path: str) -> str:
     manifest, events = obs_trace.read_log(path)
     by_kind: dict = {}
@@ -298,6 +391,21 @@ def render(path: str) -> str:
             [[f"{b['t']:.0f}", b.get("verdict"),
               (b.get("detail") or "")[:70]] for b in beats],
             ["t", "verdict", "detail"]))
+    # coupled-group vocabulary (PR 18/19): per-group policy decisions,
+    # per-group throughput, the migration trail, group-named health
+    for kind, block in (("policy_group", _policy_groups_block),
+                        ("group_chunk", _group_chunks_block),
+                        ("migrate", _migrate_block)):
+        evs = by_kind.get(kind) or []
+        if evs:
+            out.append(block(evs))
+    ghealth = [h for h in (by_kind.get("health") or [])
+               if h.get("group")]
+    if ghealth:
+        out.append(_group_health_block(ghealth))
+    anomalies = by_kind.get("anomaly") or []
+    if anomalies:
+        out.append(_anomaly_block(anomalies))
     labels = (by_kind.get("label") or []) + (by_kind.get("rung") or [])
     if labels:
         rows = []
@@ -323,6 +431,60 @@ def render(path: str) -> str:
     if not summary and not errors and not results:
         out.append("(no summary event — the run is live or died without "
                    "an epilogue; heartbeat verdicts above say which)")
+    return "\n\n".join(out)
+
+
+def render_bundle(bundle) -> str:
+    """Render a flight-recorder bundle (obs/flightrec.py): the whole
+    post-mortem from ONE self-contained file — no telemetry dir, no
+    ledger, no live process needed."""
+    head = (f"flight bundle  schema={bundle.get('schema')}  "
+            f"reason={bundle.get('reason')}  "
+            f"verdict={bundle.get('verdict')}")
+    out = [head, _manifest_block(bundle["manifest"])]
+    events = bundle.get("events") or []
+    kinds: dict = {}
+    for e in events:
+        k = e.get("kind") or "?"
+        kinds[k] = kinds.get(k, 0) + 1
+    out.append(f"ring: last {len(events)} of "
+               f"{bundle.get('events_seen')} events  "
+               + "  ".join(f"{k}={v}" for k, v in sorted(kinds.items())))
+    anomalies = bundle.get("anomalies") or []
+    if anomalies:
+        out.append(_anomaly_block(anomalies))
+    spans = bundle.get("open_spans") or []
+    if spans:
+        out.append("open spans at capture (outermost first):\n" + _table(
+            [[s.get("span_id") or "-", s.get("name") or "-",
+              f"{s.get('start', 0):.0f}"] for s in spans],
+            ["span", "name", "start"]))
+    best = bundle.get("best_known")
+    if best:
+        out.append("ledger best_known for this label: "
+                   f"{best.get('value')} {best.get('unit')} "
+                   f"(source {best.get('source')})")
+    tunnel = bundle.get("tunnel") or {}
+    out.append(f"tunnel: {tunnel.get('verdict', '?')}"
+               + (f" — {tunnel.get('detail')}" if tunnel.get("detail")
+                  else ""))
+    env = bundle.get("env") or {}
+    if env:
+        out.append("env: " + "  ".join(f"{k}={v}" for k, v in
+                                       sorted(env.items())))
+    sib = bundle.get("sibling_events") or {}
+    for src in sorted(sib):
+        recs = [e for e in sib[src] if isinstance(e, dict)]
+        skinds: dict = {}
+        for e in recs:
+            k = e.get("kind") or "?"
+            skinds[k] = skinds.get(k, 0) + 1
+        out.append(f"sibling {src} (tail): {len(recs)} events  "
+                   + "  ".join(f"{k}={v}"
+                               for k, v in sorted(skinds.items())))
+    errors = [e for e in events if e.get("kind") == "error"]
+    for e in errors:
+        out.append(f"ERROR: {e.get('error')}")
     return "\n\n".join(out)
 
 
@@ -391,6 +553,27 @@ def main(argv=None) -> int:
         return 0
     if not a.log:
         ap.error("a telemetry JSONL path is required (or use --ledger)")
+    from mpi_cuda_process_tpu.obs import flightrec as flightrec_lib
+    if flightrec_lib.is_bundle_file(a.log):
+        # a flight-recorder bundle IS the post-mortem: render it even
+        # when the telemetry dir it came from no longer exists
+        try:
+            bundle = flightrec_lib.read_bundle(a.log)
+        except (ValueError, OSError) as e:
+            print(f"obs_report: bad bundle: {e}", file=sys.stderr)
+            return 1
+        if a.check:
+            try:
+                flightrec_lib.validate_bundle(bundle)
+            except ValueError as e:
+                print(f"obs_report --check: INVALID: {e}",
+                      file=sys.stderr)
+                return 1
+            print("obs_report --check: ok (flight bundle, "
+                  f"reason={bundle.get('reason')}, "
+                  f"{len(bundle.get('events') or [])} events)")
+        print(render_bundle(bundle))
+        return 0
     if a.check:
         # the pallas auto-retry writes its own log at PATH.retry.jsonl
         # (cli.run); when present it must pass the same schema — a

@@ -468,6 +468,35 @@ def _policy_lines(status) -> list:
     return lines
 
 
+def _anomaly_lines(status) -> list:
+    """Run-doctor panel (obs/anomaly.py): finding counts by kind plus
+    the latest finding and its suspect — the evidence behind a
+    DEGRADED verdict, rendered only when findings exist (a clean run
+    shows nothing)."""
+    an = status.get("anomalies")
+    if not an:
+        return []
+    kinds = " ".join(f"{k}={v}"
+                     for k, v in sorted((an.get("kinds") or {}).items()))
+    lines = [f"doctor  {an.get('count', 0)} anomaly finding(s)  {kinds}"]
+    last = an.get("last") or {}
+    suspect = an.get("suspect") or last.get("suspect") or {}
+    if last:
+        bits = [f"last: {last.get('anomaly', '?')}",
+                f"sev={last.get('severity', '?')}"]
+        if last.get("chunk") is not None:
+            bits.append(f"chunk={last.get('chunk')}")
+        if suspect:
+            tag = (f"suspect={suspect.get('kind', '?')}:"
+                   f"{suspect.get('name', '?')}")
+            lag = suspect.get("lag_ratio")
+            if lag:
+                tag += f" (x{lag})"
+            bits.append(tag)
+        lines.append("        " + "  ".join(bits))
+    return lines
+
+
 def _hosts_lines(status) -> list:
     """Per-host/process table (obs/aggregate.py roll-up, when served)."""
     hosts = status.get("hosts")
@@ -499,6 +528,7 @@ def run_frame(status, ledger_path) -> str:
     lines += _throughput_lines(status)
     lines += _health_lines(status)
     lines += _sim_health_lines(status)
+    lines += _anomaly_lines(status)
     lines += _groups_lines(status)
     lines += _scheduler_lines(status)
     lines += _fleet_lines(status)
@@ -520,14 +550,28 @@ def ledger_frame(path) -> str:
         reasons[key] = reasons.get(key, 0) + 1
     out = [f"ledger {path}: {len(rows)} rows "
            f"({len(quarantined)} quarantined), {len(best)} baselines"]
+    # staleness flag: the distinct UTC days best_known rows were
+    # measured on stand in for campaign rounds; a baseline older than
+    # the latest two measurement days is a number nobody has
+    # re-confirmed recently — flagged, never hidden
+    def _day(ts):
+        return (time.strftime("%Y-%m-%d", time.gmtime(ts))
+                if isinstance(ts, (int, float)) else None)
+    days = sorted({d for d in (_day(best[bk].get("measured_at"))
+                               for bk in best) if d}, reverse=True)
+    fresh = set(days[:2])
     trows = []
     for bk in sorted(best):
         r = best[bk]
+        ts = r.get("measured_at")
+        age_d = (f"{max(0.0, time.time() - ts) / 86400:.1f}"
+                 if isinstance(ts, (int, float)) else "-")
+        flag = "" if _day(ts) in fresh else "stale?"
         trows.append([bk, r["value"], r["unit"],
-                      _age(r.get("measured_at")), r["source"][:40]])
+                      _age(ts), age_d, flag, r["source"][:40]])
     if trows:
         out.append(_table(trows, ["label|backend", "best", "unit",
-                                  "measured", "source"]))
+                                  "measured", "age_d", "flag", "source"]))
     if reasons:
         out.append("quarantine reasons:")
         for k, v in sorted(reasons.items(), key=lambda kv: -kv[1]):
@@ -579,11 +623,13 @@ def health_rc(status) -> int:
     """CI/campaign health probe verdict for ``--once``: nonzero when
     the latest heartbeat verdict is WEDGED/STALLED, the numerics
     sentinel says DIVERGED (same contract — a diverged run failed, in
-    the way that matters most), the supervisor gave up, or — on an
+    the way that matters most), the run doctor says DEGRADED (it
+    finished, but slower than its own evidence says it should have —
+    a CI gate must notice), the supervisor gave up, or — on an
     aggregate page — ANY host is in one of those states."""
     if not status:
         return 0
-    bad = ("WEDGED", "STALLED", "GAVE_UP", "DIVERGED")
+    bad = ("WEDGED", "STALLED", "GAVE_UP", "DIVERGED", "DEGRADED")
     if status.get("verdict") in bad or status.get("give_up"):
         return 1
     if (status.get("health") or {}).get("verdict") == "DIVERGED":

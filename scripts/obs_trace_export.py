@@ -14,7 +14,11 @@ slices are the span vocabulary — ``compile``, ``chunk``,
 them and synthesized from ``chunk`` events (``t`` − ``wall_s``)
 everywhere, so pre-span logs still export.  Instant markers carry the
 point events: heartbeat verdicts, launches, errors, give-up, exchange
-mode.
+mode, policy/``policy_group`` decisions, ``migrate``, (group-named)
+``health`` verdicts, and run-doctor ``anomaly`` findings.  Coupled
+``--groups`` runs additionally get one synthetic track per device
+group built from its ``group_chunk`` events, so heterogeneous physics
+renders side by side.
 
 Every exported slice keeps its ``trace_id``/``span_id``/``parent_id``
 in ``args``, so "do the supervisor and both attempts share one trace?"
@@ -46,9 +50,14 @@ from typing import Any, Dict, List, Optional, Tuple
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-# instant-marker mapping: obs event kind -> slice name builder
+# instant-marker mapping: obs event kind -> slice name builder.
+# policy / policy_group / migrate / health / anomaly joined in round 20
+# so --groups runs (PR 18/19 vocabulary) and run-doctor findings land
+# on the timeline instead of vanishing.
 _INSTANT_KINDS = ("heartbeat", "launch", "give_up", "error", "abort",
-                  "resume", "exchange", "serve", "summary", "restart")
+                  "resume", "exchange", "serve", "summary", "restart",
+                  "policy", "policy_group", "migrate", "health",
+                  "anomaly")
 
 
 def discover(arg: str) -> List[str]:
@@ -138,6 +147,10 @@ def build_trace(paths: List[str]) -> Dict[str, Any]:
         if mtrace.get("trace_id"):
             trace_ids.add(mtrace["trace_id"])
         src = os.path.basename(path)
+        # coupled runs (--groups): one synthetic thread per device
+        # group under this log's process, so Perfetto shows the groups
+        # side by side instead of interleaved on one track
+        gtids: Dict[str, int] = {}
         for rec in recs:
             kind = rec.get("kind")
             t = rec.get("t")
@@ -171,6 +184,30 @@ def build_trace(paths: List[str]) -> Dict[str, Any]:
                     "name": f"chunk {n}", "ph": "X", "cat": "chunk",
                     "ts": _us(t - wall), "dur": max(1.0, _us(wall)),
                     "pid": pid, "tid": tid_num, "args": args})
+            elif kind == "group_chunk" and isinstance(t, (int, float)):
+                wall = rec.get("wall_s")
+                gname = rec.get("group")
+                if not isinstance(wall, (int, float)) or wall <= 0 or \
+                        not isinstance(gname, str) or not gname:
+                    continue
+                gt = gtids.get(gname)
+                if gt is None:
+                    # tids 1..N are source logs; group tracks live in a
+                    # disjoint per-log band so they can never collide
+                    gt = gtids[gname] = 1000 * tid_num + len(gtids) + 1
+                    events.append({"name": "thread_name", "ph": "M",
+                                   "pid": pid, "tid": gt,
+                                   "args": {"name": f"{thread}:{gname}"}})
+                args = {k: rec.get(k) for k in
+                        ("group", "op", "ratio", "dtype", "step",
+                         "steps", "ready_ms_per_step", "mcells_per_s")
+                        if rec.get(k) is not None}
+                args["file"] = src
+                events.append({
+                    "name": f"{gname} chunk@{rec.get('step')}",
+                    "ph": "X", "cat": "group_chunk",
+                    "ts": _us(t - wall), "dur": max(1.0, _us(wall)),
+                    "pid": pid, "tid": gt, "args": args})
             elif kind in _INSTANT_KINDS and isinstance(t, (int, float)):
                 name = kind
                 if kind == "heartbeat":
@@ -179,9 +216,39 @@ def build_trace(paths: List[str]) -> Dict[str, Any]:
                     name = f"launch attempt {rec.get('attempt')}"
                 elif kind == "exchange":
                     name = f"exchange {rec.get('mode')}"
+                elif kind == "policy_group":
+                    name = f"policy_group {rec.get('group')}"
+                elif kind == "migrate":
+                    name = f"migrate@{rec.get('step')}"
+                elif kind == "health":
+                    name = (f"health {rec.get('group')} "
+                            f"{rec.get('verdict')}" if rec.get("group")
+                            else f"health {rec.get('verdict')}")
+                elif kind == "anomaly":
+                    name = f"anomaly {rec.get('anomaly')}"
                 args = {k: v for k, v in rec.items()
                         if k not in ("schema", "kind", "t")
                         and isinstance(v, (str, int, float, bool))}
+                # the scalars-only filter above would drop the list
+                # payloads these events are ABOUT — summarize them
+                if kind == "policy":
+                    gds = rec.get("group_decisions")
+                    if isinstance(gds, list) and gds:
+                        args["groups"] = ",".join(
+                            str(d.get("group")) for d in gds
+                            if isinstance(d, dict))
+                elif kind == "policy_group":
+                    modes = rec.get("modes")
+                    if isinstance(modes, (list, tuple)):
+                        args["modes"] = ",".join(str(m) for m in modes)
+                    elif isinstance(modes, dict):
+                        args["modes"] = ",".join(
+                            f"{k}={v}" for k, v in sorted(modes.items()))
+                elif kind == "anomaly":
+                    suspect = rec.get("suspect")
+                    if isinstance(suspect, dict):
+                        args["suspect"] = (f"{suspect.get('kind')}:"
+                                           f"{suspect.get('name')}")
                 args["file"] = src
                 events.append({"name": name, "ph": "i", "s": "t",
                                "cat": kind, "ts": _us(t), "pid": pid,

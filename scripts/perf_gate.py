@@ -22,7 +22,11 @@ A row whose value was measured after a supervised restart/resume
 ``restart_attempts`` / ``resumed_from_step``) is judged normally but
 FLAGGED ``[after-restart]`` in the table and counted in the summary:
 the value is honest (resume is bit-exact), the wall-clock path that
-produced it was not uninterrupted.
+produced it was not uninterrupted.  A row whose run carried run-doctor
+anomaly findings (``--anomaly``, detail ``degraded=N``) gets the same
+treatment: judged normally, FLAGGED ``[degraded]``, counted in the
+summary — a slow run is not a dead run, but the number deserves its
+asterisk.
 
 Exit status: 0 clean, 1 when any row REGRESSED (CI-gate mode), 2 on
 usage/IO errors.  ``--dry`` always exits 0 (the tier-1 smoke mode —
@@ -110,6 +114,12 @@ def gate(manifest_path: str, ledger_path: str, noise: float):
         restarted = bool(det.get("attempts", 0) and det["attempts"] > 1) \
             or bool(det.get("restart_attempts")) \
             or det.get("resumed_from_step") is not None
+        # Same discipline for the run doctor (--anomaly): a value from
+        # a run that carried anomaly findings is honest — the steps ran
+        # and the numbers are real — but DEGRADED, so the row is
+        # flagged rather than quarantined.
+        degraded = det.get("degraded")
+        degraded = int(degraded) if isinstance(degraded, int) else 0
         out.append({
             "label": row["label"],
             "backend": row["key"].get("backend"),
@@ -120,6 +130,7 @@ def gate(manifest_path: str, ledger_path: str, noise: float):
             "ratio": round(ratio, 4) if ratio is not None else None,
             "quarantine": row.get("quarantine"),
             "restarted": restarted,
+            "degraded": degraded,
             "baseline_source": base["source"] if base else None,
             "baseline_measured_at": base.get("measured_at")
             if base else None,
@@ -227,6 +238,8 @@ def _table(rows):
             else (r["baseline_source"] or "")
         if r.get("restarted"):
             why = ("[after-restart] " + (why or "")).strip()
+        if r.get("degraded"):
+            why = ("[degraded] " + (why or "")).strip()
         body.append([
             r["label"][:58], r["verdict"],
             "-" if r["value"] is None else f"{r['value']:g}",
@@ -307,9 +320,11 @@ def main(argv=None) -> int:
     print(_table(verdicts) if verdicts else "(no measurement rows in "
                                            "this manifest)")
     restarted = sum(1 for r in verdicts if r.get("restarted"))
+    degraded = sum(1 for r in verdicts if r.get("degraded"))
     print("summary: " + "  ".join(
         f"{v}={counts.get(v, 0)}" for v in VERDICT_ORDER)
-        + (f"  restarted={restarted}" if restarted else ""))
+        + (f"  restarted={restarted}" if restarted else "")
+        + (f"  degraded={degraded}" if degraded else ""))
 
     if a.update_ledger:
         n = ledger_lib.append_rows(fresh, ledger_path)

@@ -696,6 +696,121 @@ print('collective groups smoke ok: %d ppermutes, 0 device_put, '
 " || rc=1
 timeout -k 10 120 python scripts/obs_report.py /tmp/_t1_grpcoll.jsonl \
   --check > /dev/null || rc=1
+# Run-doctor smoke (round 24, ISSUE 20): the performance-anomaly E2E
+# pin.  Two injected sleep faults (the 'sleep:MS' action stalls the
+# chunk boundary OUTSIDE the fenced device window — exactly where real
+# boundary trouble lands) under --anomaly --serve 0 must (1) flag a
+# boundary_stall within 2 chunk boundaries of the first stall with the
+# host named as suspect, (2) flip /status.json to DEGRADED, scraped
+# LIVE during the second injected stall (an 800 ms window the 20 Hz
+# poller cannot miss), (3) finish the run anyway (DEGRADED warns, never
+# kills — a slow run is not a dead run) with the ledger row flagged
+# degraded=N but NOT quarantined, and (4) leave the flight-recorder
+# bundle next to the log, self-validating via obs_report --check.
+# obs_top --once on the log must exit nonzero (the DEGRADED CI-probe
+# contract, same as WEDGED/DIVERGED).
+rm -rf /tmp/_t1_doctor
+mkdir -p /tmp/_t1_doctor
+timeout -k 10 300 env \
+  FAULT_INJECT='exchange:step=8:sleep:500,exchange:step=12:sleep:800' \
+  python -c "
+import json, threading, time, urllib.request
+from cpuforce import force_cpu; force_cpu()
+from mpi_cuda_process_tpu import cli
+from mpi_cuda_process_tpu.obs import ledger
+tel = '/tmp/_t1_doctor/run.jsonl'
+seen = {}
+def scrape():
+    url = None
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and url is None:
+        try:
+            for line in open(tel):
+                rec = json.loads(line)
+                if rec.get('kind') == 'serve':
+                    url = rec['url']
+        except (OSError, ValueError):
+            pass
+        if url is None:
+            time.sleep(0.05)
+    while time.monotonic() < deadline and 'degraded' not in seen:
+        try:
+            s = json.load(urllib.request.urlopen(url + '/status.json',
+                                                 timeout=5))
+            if s.get('verdict') == 'DEGRADED':
+                seen['degraded'] = s
+        except OSError:
+            pass
+        time.sleep(0.05)
+t = threading.Thread(target=scrape); t.start()
+cli.run(cli.config_from_args(
+    ['--stencil', 'heat2d', '--grid', '16,64', '--iters', '24',
+     '--log-every', '2', '--anomaly', '--serve', '0',
+     '--telemetry', tel]))
+t.join()
+s = seen.get('degraded')
+assert s is not None, 'never scraped a live DEGRADED /status.json'
+an = s.get('anomalies') or {}
+assert an.get('count', 0) >= 1, an
+assert (an.get('suspect') or {}).get('name'), an
+evs = [json.loads(line) for line in open(tel) if line.strip()]
+anoms = [e for e in evs if e.get('kind') == 'anomaly']
+assert anoms and anoms[0]['anomaly'] == 'boundary_stall', anoms[:1]
+# flagged within 2 chunk boundaries of the step-8 stall
+assert anoms[0].get('step', 99) <= 12, anoms[0]
+assert any(e.get('kind') == 'summary' for e in evs), 'run must finish'
+rows = [r for r in ledger.rows_from_log(tel) if r.get('value')]
+assert rows and rows[0]['status'] == 'ok', rows
+assert rows[0]['detail']['degraded'] == len(anoms), rows[0]
+import os
+assert os.path.exists('/tmp/_t1_doctor/run.bundle.json'), 'no bundle'
+print('doctor smoke ok: %d finding(s), suspect %s, DEGRADED live,'
+      ' ledger degraded=%d, bundle on exit' % (
+          len(anoms), an['suspect']['name'],
+          rows[0]['detail']['degraded']))
+" || rc=1
+timeout -k 10 120 python scripts/obs_report.py \
+  /tmp/_t1_doctor/run.bundle.json --check > /dev/null || rc=1
+if timeout -k 10 120 python scripts/obs_top.py /tmp/_t1_doctor/run.jsonl \
+     --once > /dev/null; then
+  echo 'obs_top --once must exit nonzero on a DEGRADED log' >&2; rc=1
+fi
+# Flight-recorder give-up smoke: a wedged child (exchange hang) under a
+# no-restart supervisor must leave the post-mortem bundle — the
+# supervisor's own ring plus the SIGKILLed child's log tail — and the
+# bundle must render standalone AFTER the telemetry directory is
+# deleted (the whole point of a flight recorder: the evidence survives
+# the crash site).
+rm -rf /tmp/_t1_flight
+timeout -k 10 240 env FAULT_INJECT='exchange:step=40:hang' \
+  FAULT_HANG_S=120 python -c "
+import json
+from cpuforce import force_cpu; force_cpu()
+from mpi_cuda_process_tpu.config import RunConfig
+from mpi_cuda_process_tpu.resilience import supervisor as sup
+rc = sup.run_supervised(RunConfig(
+    stencil='life', grid=(64, 64), iters=100, seed=7,
+    checkpoint_every=10, checkpoint_dir='/tmp/_t1_flight/ck',
+    telemetry='/tmp/_t1_flight/run.jsonl', supervise=True,
+    max_restarts=0, restart_backoff=0.3, supervise_stall_s=8.0))
+assert rc == 1, f'supervisor rc={rc} (want give-up)'
+evs = [json.loads(l)
+       for l in open('/tmp/_t1_flight/run.supervisor.jsonl') if l.strip()]
+gu = [e for e in evs if e.get('kind') == 'give_up']
+assert gu, [e.get('kind') for e in evs]
+bun = [e for e in evs if e.get('kind') == 'bundle']
+assert bun and bun[0].get('path'), 'give-up must record its bundle'
+print('BUNDLE_PATH=' + bun[0]['path'])
+" | tee /tmp/_t1_flight_out.txt || rc=1
+bundle_path=$(grep -a '^BUNDLE_PATH=' /tmp/_t1_flight_out.txt | cut -d= -f2)
+if [ -n "$bundle_path" ] && [ -f "$bundle_path" ]; then
+  cp "$bundle_path" /tmp/_t1_flight.bundle.json
+  rm -rf /tmp/_t1_flight   # the crash site is gone; the bundle survives
+  timeout -k 10 120 python scripts/obs_report.py \
+    /tmp/_t1_flight.bundle.json --check > /dev/null || rc=1
+else
+  echo 'give-up flight bundle missing' >&2; rc=1
+fi
 # The committed campaign ledger must render in both one-command
 # summary surfaces: obs_report --ledger (best_known + quarantine
 # table) and the terminal monitor's ledger mode.
